@@ -1,0 +1,23 @@
+"""ddim_cold_tpu — a TPU-native (JAX/XLA/pjit/Pallas) diffusion framework.
+
+Re-implements, TPU-first, the full capability surface of the DDIM-COLD
+reference codebase (DDIM image generation with a ViT x0-predicting backbone,
+Cold Diffusion via nearest-neighbor downsampling, distributed data-parallel
+training, guided zero-shot sampling applications), plus the scale-out layers
+(mesh/tensor/sequence parallelism, Pallas kernels) the reference reaches only
+through the CUDA runtime.
+
+Layering (bottom-up), mirroring SURVEY.md §1's target design:
+
+  parallel/  mesh + sharding + collectives (replaces NCCL/DDP)
+  data/      host-side image pipeline with per-host sharding
+             (replaces DataLoader + DistributedSampler)
+  models/    Flax DiffusionViT (replaces torch nn.Module model)
+  ops/       schedules, samplers (lax.scan), degradation ops, attention
+             (replaces Python sampler loops / cuDNN attention)
+  train/     pjit SPMD train step + loop (replaces DDP/AMP/GradScaler)
+  utils/     logging, checkpointing, image IO
+  cli/       entry points preserving the reference's CLI surface
+"""
+
+__version__ = "0.1.0"

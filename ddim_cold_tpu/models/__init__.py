@@ -1,0 +1,8 @@
+from ddim_cold_tpu.models.vit import (
+    DiffusionViT,
+    MODEL_CONFIGS,
+    positionalencoding1d,
+)
+from ddim_cold_tpu.models import init
+
+__all__ = ["DiffusionViT", "MODEL_CONFIGS", "positionalencoding1d", "init"]

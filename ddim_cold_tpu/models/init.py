@@ -1,0 +1,62 @@
+"""Parameter initializers matching the reference's torch semantics.
+
+The reference initializes every Linear/embedding/pos-embed with
+``trunc_normal_(std=.02)`` whose truncation bounds are the *absolute* values
+[a, b] = [−2, 2] (reference ViT.py:12-50) — NOT ±2 standard deviations as in
+``jax.nn.initializers.truncated_normal``. With std=0.02 the bounds sit at
+±100σ, so the distribution is effectively an untruncated N(0, 0.02²), but we
+reproduce the inverse-CDF construction exactly so the semantics hold for any
+(std, a, b).
+
+The patch-embedding projection is a ``nn.Conv2d`` which the reference's
+``_init_weights`` does NOT touch (it matches only Linear/LayerNorm,
+ViT.py:189-196), so it keeps torch's default ``kaiming_uniform_(a=√5)``:
+U(−1/√fan_in, 1/√fan_in) for both kernel and bias. ``torch_default_uniform``
+reproduces that.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _norm_cdf(x: float) -> float:
+    return (1.0 + math.erf(x / math.sqrt(2.0))) / 2.0
+
+
+def trunc_normal(std: float = 0.02, mean: float = 0.0, a: float = -2.0, b: float = 2.0):
+    """Truncated normal with ABSOLUTE bounds [a, b] (torch ``trunc_normal_`` semantics).
+
+    Inverse-CDF construction identical to reference ViT.py:24-45: sample
+    U(2l−1, 2u−1) where l,u are the CDF values of the bounds, apply erfinv,
+    scale by std·√2, shift by mean, clamp to [a, b].
+    """
+    lo = _norm_cdf((a - mean) / std)
+    hi = _norm_cdf((b - mean) / std)
+
+    def init(key, shape, dtype=jnp.float32):
+        u = jax.random.uniform(
+            key, shape, dtype=jnp.float32, minval=2 * lo - 1, maxval=2 * hi - 1
+        )
+        x = jax.scipy.special.erfinv(u) * (std * math.sqrt(2.0)) + mean
+        return jnp.clip(x, a, b).astype(dtype)
+
+    return init
+
+
+def torch_default_uniform(fan_in: int):
+    """torch's default Linear/Conv init: kaiming_uniform_(a=√5) ⇒ U(±1/√fan_in).
+
+    gain = √(2/(1+5)) = √(1/3); bound = gain·√(3/fan_in) = 1/√fan_in. Used for
+    the patch-embed projection (and its bias), which the reference leaves at
+    torch defaults.
+    """
+    bound = 1.0 / math.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-bound, maxval=bound)
+
+    return init

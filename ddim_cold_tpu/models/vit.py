@@ -1,0 +1,358 @@
+"""DiffusionViT — the x0-predicting Vision Transformer backbone, TPU-first.
+
+Re-implements the reference's ``DiffusionVisionTransformer`` (ViT.py:158-218;
+the trainer imports the identical copy in ViT_draft2drawing.py:175-238 — the
+build keeps ONE module, SURVEY.md quirk #6) as a Flax linen module:
+
+* NHWC image layout (TPU-native; the torch reference is NCHW — the checkpoint
+  converter in utils/checkpoint.py handles the transpose).
+* Patch embedding as reshape + Dense instead of Conv2d: for kernel=stride=p
+  the two are identical linear maps, and the reshape+matmul form feeds the MXU
+  one large GEMM with no im2col.
+* Attention as einsum with float32 softmax; mlp_ratio defaults to 1.0 and
+  qkv_bias to True per the reference ctor defaults (ViT.py:160-162).
+* Time conditioning: a learned ``Embed(total_steps, dim)`` row added to every
+  token together with the learned positional embedding (ViT.py:204-205).
+* Output head predicts the clean image x̂0 directly: Linear(dim → C·p²) then
+  un-patchify with the exact pixel mapping of the reference's
+  ``view/permute(0,5,1,3,2,4)/view`` (ViT.py:214-217).
+* Stochastic depth linearly scaled 0 → drop_path_rate across blocks
+  (ViT.py:176), active only in training; dropout 0.1 on pos/attn/proj/mlp.
+
+Compute dtype is configurable (bfloat16 replaces the reference's CUDA AMP);
+parameters always live in float32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.models.init import torch_default_uniform, trunc_normal
+
+Dtype = Any
+
+#: Model configurations appearing in the reference (SURVEY.md §2 table).
+MODEL_CONFIGS = {
+    # reference ViT.py:277
+    "oxford_flower_64": dict(
+        img_size=(64, 64), patch_size=4, embed_dim=256, depth=6, num_heads=4
+    ),
+    # reference ViT.py:274 / 20220822.yaml:12-15 / ViT_draft2drawing.py:342
+    "vit_tiny": dict(
+        img_size=(64, 64), patch_size=8, embed_dim=384, depth=7, num_heads=12
+    ),
+    # checkpoint name only (README.md:28-29); config absent upstream — both
+    # plausible patch sizes are provided, selectable by state-dict shapes.
+    "oxford_flower_200_p4": dict(
+        img_size=(200, 200), patch_size=4, embed_dim=256, depth=6, num_heads=4
+    ),
+    "oxford_flower_200_p8": dict(
+        img_size=(200, 200), patch_size=8, embed_dim=384, depth=7, num_heads=12
+    ),
+}
+
+
+def positionalencoding1d(d_model: int, length: int) -> np.ndarray:
+    """Sinusoidal 1-D positional encoding (reference ViT_draft2drawing.py:140-156).
+
+    Kept as an option for large-image configs (>64px), where the reference
+    sketches swapping the learned pos_embed for this fixed table
+    (ViT_draft2drawing.py:191-193).
+    """
+    if d_model % 2 != 0:
+        raise ValueError(f"Cannot use sin/cos positional encoding with odd dim {d_model}")
+    pe = np.zeros((length, d_model), dtype=np.float32)
+    position = np.arange(0, length, dtype=np.float32)[:, None]
+    div_term = np.exp(np.arange(0, d_model, 2, dtype=np.float32) * -(math.log(10000.0) / d_model))
+    pe[:, 0::2] = np.sin(position * div_term)
+    pe[:, 1::2] = np.cos(position * div_term)
+    return pe
+
+
+class Mlp(nn.Module):
+    """2-layer GELU MLP with dropout after both linears (reference ViT.py:74-90)."""
+
+    hidden_features: int
+    out_features: int
+    drop: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        dense = lambda feat, name: nn.Dense(
+            feat,
+            dtype=self.dtype,
+            kernel_init=trunc_normal(std=0.02),
+            bias_init=nn.initializers.zeros_init(),
+            name=name,
+        )
+        x = dense(self.hidden_features, "fc1")(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dropout(self.drop, deterministic=deterministic)(x)
+        x = dense(self.out_features, "fc2")(x)
+        x = nn.Dropout(self.drop, deterministic=deterministic)(x)
+        return x
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention, fused-QKV (reference ViT.py:93-117).
+
+    Returns ``(x, attn)`` like the reference so the attention-probe path
+    (Block.return_attention) stays expressible. Softmax runs in float32
+    regardless of compute dtype. The einsum layout keeps the two contractions
+    as plain batched GEMMs for the MXU and is the slot-in point for the Pallas
+    flash-attention kernel used by long-sequence configs.
+    """
+
+    dim: int
+    num_heads: int = 8
+    qkv_bias: bool = False
+    qk_scale: Optional[float] = None
+    attn_drop: float = 0.0
+    proj_drop: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True):
+        B, N, C = x.shape
+        head_dim = C // self.num_heads
+        scale = self.qk_scale or head_dim**-0.5
+
+        qkv = nn.Dense(
+            3 * self.dim,
+            use_bias=self.qkv_bias,
+            dtype=self.dtype,
+            kernel_init=trunc_normal(std=0.02),
+            bias_init=nn.initializers.zeros_init(),
+            name="qkv",
+        )(x)
+        # unpack order (3, heads, head_dim) matches the torch reshape
+        # (B,N,3,H,hd) so converted checkpoints line up slice-for-slice.
+        qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, N, H, hd)
+
+        logits = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+        attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        attn = nn.Dropout(self.attn_drop, deterministic=deterministic)(attn)
+        out = jnp.einsum("bhnm,bmhd->bnhd", attn, v)
+
+        out = out.reshape(B, N, C)
+        out = nn.Dense(
+            self.dim,
+            dtype=self.dtype,
+            kernel_init=trunc_normal(std=0.02),
+            bias_init=nn.initializers.zeros_init(),
+            name="proj",
+        )(out)
+        out = nn.Dropout(self.proj_drop, deterministic=deterministic)(out)
+        return out, attn
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block with stochastic-depth residuals (reference ViT.py:120-138)."""
+
+    dim: int
+    num_heads: int
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = False
+    qk_scale: Optional[float] = None
+    drop: float = 0.0
+    attn_drop: float = 0.0
+    drop_path: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True, return_attention: bool = False):
+        ln = lambda name: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name=name)
+        y, attn = Attention(
+            dim=self.dim,
+            num_heads=self.num_heads,
+            qkv_bias=self.qkv_bias,
+            qk_scale=self.qk_scale,
+            attn_drop=self.attn_drop,
+            proj_drop=self.drop,
+            dtype=self.dtype,
+            name="attn",
+        )(ln("norm1")(x), deterministic=deterministic)
+        if return_attention:
+            return attn
+
+        # per-sample stochastic depth (reference ViT.py:52-71): Bernoulli(keep)
+        # mask broadcast over all but the batch dim, survivors scaled 1/keep —
+        # exactly nn.Dropout with broadcast_dims.
+        residual = nn.Dropout(self.drop_path, broadcast_dims=(1, 2), deterministic=deterministic)
+
+        x = x + residual(y)
+        y = Mlp(
+            hidden_features=int(self.dim * self.mlp_ratio),
+            out_features=self.dim,
+            drop=self.drop,
+            dtype=self.dtype,
+            name="mlp",
+        )(ln("norm2")(x), deterministic=deterministic)
+        x = x + residual(y)
+        return x
+
+
+class PatchEmbed(nn.Module):
+    """Image → patch tokens as one GEMM (reference ViT.py:141-155 uses Conv2d).
+
+    For kernel=stride=p a convolution is exactly a linear map on flattened
+    patches; the reshape+Dense form is the MXU-friendly expression. The patch
+    feature order (row, col, channel — channel fastest) matches the torch conv
+    weight layout after ``W.transpose(2,3,1,0).reshape(p²C, E)`` so converted
+    checkpoints are bit-identical.
+
+    Init: torch Conv2d default (kaiming_uniform a=√5) — the reference's
+    ``_init_weights`` skips Conv2d (models/init.py docstring).
+    """
+
+    patch_size: int
+    embed_dim: int
+    in_chans: int = 3
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        p = self.patch_size
+        hp, wp = H // p, W // p
+        fan_in = C * p * p
+        x = x.reshape(B, hp, p, wp, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, hp, wp, p, p, C)
+        x = x.reshape(B, hp * wp, p * p * C)
+        x = nn.Dense(
+            self.embed_dim,
+            dtype=self.dtype,
+            kernel_init=torch_default_uniform(fan_in),
+            bias_init=torch_default_uniform(fan_in),
+            name="proj",
+        )(x)
+        return x
+
+
+class DiffusionViT(nn.Module):
+    """The diffusion backbone: ``(x_t, t) → x̂0`` (reference ViT.py:158-218).
+
+    Inputs are NHWC in [−1, 1]; ``t`` is an int32 vector of per-sample steps in
+    [0, total_steps). Out-of-range steps produce NaN outputs (JAX fills
+    out-of-bounds gathers) — the traced-code analogue of torch's IndexError.
+    Constructor defaults mirror the reference ctor (ViT.py:160-162):
+    mlp_ratio=1.0, qkv_bias=True, all drop rates 0.1, total_steps=2000.
+    ``diff_step``-style cold configs keep the full 2000-row time-embedding
+    table (SURVEY.md quirk #4) unless ``total_steps`` is overridden.
+    """
+
+    img_size: Sequence[int] = (64, 64)
+    patch_size: int = 8
+    in_chans: int = 3
+    embed_dim: int = 256
+    depth: int = 3
+    num_heads: int = 4
+    mlp_ratio: float = 1.0
+    qkv_bias: bool = True
+    qk_scale: Optional[float] = None
+    drop_rate: float = 0.1
+    attn_drop_rate: float = 0.1
+    drop_path_rate: float = 0.1
+    total_steps: int = 2000
+    dtype: Dtype = jnp.float32
+    use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.img_size[0] // self.patch_size) * (self.img_size[1] // self.patch_size)
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        t: jax.Array,
+        deterministic: bool = True,
+        return_attention_layer: Optional[int] = None,
+    ) -> jax.Array:
+        B = x.shape[0]
+        E = self.embed_dim
+        N = self.num_patches
+
+        x = x.astype(self.dtype)
+        tokens = PatchEmbed(
+            patch_size=self.patch_size,
+            embed_dim=E,
+            in_chans=self.in_chans,
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+
+        cls_token = self.param("cls_token", trunc_normal(std=0.02), (1, 1, E))
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(cls_token.astype(self.dtype), (B, 1, E)), tokens], axis=1
+        )
+
+        # time conditioning: one learned row per step, added to EVERY token
+        # (cls included) together with the positional embedding (ViT.py:204-205).
+        time_embed = nn.Embed(
+            self.total_steps,
+            E,
+            embedding_init=trunc_normal(std=0.02),
+            dtype=self.dtype,
+            name="time_embed",
+        )(t.astype(jnp.int32))[:, None, :]
+
+        if self.use_sincos_pos:
+            pos_embed = jnp.asarray(positionalencoding1d(E, N + 1))[None]
+        else:
+            pos_embed = self.param("pos_embed", trunc_normal(std=0.02), (1, N + 1, E))
+        tokens = tokens + pos_embed.astype(self.dtype) + time_embed
+        tokens = nn.Dropout(self.drop_rate, deterministic=deterministic, name="pos_drop")(tokens)
+
+        # stochastic depth decay rule: linspace(0, rate, depth) (ViT.py:176)
+        dpr = np.linspace(0.0, self.drop_path_rate, self.depth)
+        for i in range(self.depth):
+            blk = Block(
+                dim=E,
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                qkv_bias=self.qkv_bias,
+                qk_scale=self.qk_scale,
+                drop=self.drop_rate,
+                attn_drop=self.attn_drop_rate,
+                drop_path=float(dpr[i]),
+                dtype=self.dtype,
+                name=f"blocks_{i}",
+            )
+            if return_attention_layer is not None and i == return_attention_layer % self.depth:
+                # attention probe (reference Block.return_attention, ViT.py:132-135)
+                return blk(tokens, deterministic=deterministic, return_attention=True)
+            tokens = blk(tokens, deterministic=deterministic)
+
+        tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
+        tokens = nn.Dense(
+            self.in_chans * self.patch_size**2,
+            dtype=self.dtype,
+            kernel_init=trunc_normal(std=0.02),
+            bias_init=nn.initializers.zeros_init(),
+            name="head",
+        )(tokens)
+        return self.unpatchify(tokens[:, 1:, :]).astype(jnp.float32)
+
+    def unpatchify(self, x: jax.Array) -> jax.Array:
+        """(B, N, p²C) → (B, H, W, C), exact reference pixel mapping.
+
+        The torch path (ViT.py:214-217) views the feature dim as (p, p, C)
+        with C fastest, then permute(0,5,1,3,2,4): pixel (i·p+a, j·p+b, c) ←
+        feature a·pC + b·C + c of patch (i, j). NHWC equivalent below.
+        """
+        p = self.patch_size
+        C = self.in_chans
+        H, W = self.img_size
+        B = x.shape[0]
+        x = x.reshape(B, H // p, W // p, p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, H/p, p, W/p, p, C)
+        return x.reshape(B, H, W, C)

@@ -1,0 +1,3 @@
+from ddim_cold_tpu.ops import schedule
+
+__all__ = ["schedule"]

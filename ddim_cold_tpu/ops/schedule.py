@@ -1,0 +1,111 @@
+"""Diffusion schedules.
+
+The reference uses a nonstandard signal-level schedule (reference ViT.py:231-232):
+
+    alpha_bar(t)   = 1 - sqrt((t + 1) / T)            [+ 1e-5 on the *current* step only]
+
+i.e. ``x_t = sqrt(alpha_bar) * x_0 + sqrt(1 - alpha_bar) * eps``. The +1e-5 is
+applied asymmetrically — to ``alpha_t`` (the current noise level) but NOT to
+``alpha_tk`` (the target level of the DDIM jump). This asymmetry affects sampler
+outputs and is replicated exactly (SURVEY.md quirk #5).
+
+All schedule values are computed host-side in float64 (matching Python-float
+math in the reference) and handed to jitted loops as static per-step arrays, so
+no schedule math runs on device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import numpy as np
+
+#: epsilon added to the *current* alpha only (reference ViT.py:232)
+ALPHA_EPS = 1e-5
+
+
+def alpha_bar(t, total_steps: int, eps: float = 0.0):
+    """Signal level ᾱ(t) = 1 − √((t+1)/T) + eps.
+
+    Works on Python ints/floats and numpy arrays. ``eps`` is ``ALPHA_EPS`` when
+    evaluating the current step in a sampler update, 0 for the jump target.
+    """
+    t = np.asarray(t, dtype=np.float64)
+    return 1.0 - np.sqrt((t + 1.0) / float(total_steps)) + eps
+
+
+def forward_noise_alpha(t_start: int, total_steps: int) -> float:
+    """ᾱ used when forward-noising an image to level ``t_start``.
+
+    The draft2drawing app uses ``1 - sqrt(t_start / T)`` — note: *no* +1
+    (reference ViT_draft2drawing.py:395), unlike the sampler's ``(t+1)/T``.
+    """
+    return 1.0 - math.sqrt(t_start / float(total_steps))
+
+
+def ddim_time_sequence(total_steps: int, k: int, t_start: int | None = None) -> np.ndarray:
+    """The reverse-process visit order: t = t_start, t_start−k, …, > 0.
+
+    Mirrors ``range(T-1, 0, -k)`` (reference ViT.py:226); ``t_start`` defaults
+    to T−1 and is overridable for guided sampling (draft2drawing restarts).
+    """
+    if t_start is None:
+        t_start = total_steps - 1
+    return np.arange(t_start, 0, -k, dtype=np.int64)
+
+
+class DDIMCoefficients(NamedTuple):
+    """Per-step affine coefficients of the reference's DDIM update.
+
+    The reference update (ViT.py:231-234), with x = noisy image, x0 = clamped
+    model prediction:
+
+        a_t  = ᾱ(t)   + 1e-5        (ALPHA_EPS asymmetry)
+        a_tk = ᾱ(t−k)               (no eps; t−k clamped at −1 → ᾱ=1−√0=1... )
+        noise = (x − √a_t·x0) / √(1−a_t)
+        x'    = √a_tk · ( x/√a_t + (√((1−a_tk)/a_tk) − √((1−a_t)/a_t)) · noise )
+
+    which is affine in (x, x0):  x' = cx·x + cx0·x0. We precompute (cx, cx0)
+    host-side in float64; the on-device scan is then two fused multiplies.
+
+    Fields are float32 numpy arrays of shape (n_steps,), plus the int32 time
+    sequence fed to the model.
+    """
+
+    t_seq: np.ndarray  # (n,) int32 — model conditioning step at each iteration
+    cx: np.ndarray  # (n,) float32 — coefficient on the current noisy image
+    cx0: np.ndarray  # (n,) float32 — coefficient on the clamped x0 prediction
+
+
+def ddim_coefficients(total_steps: int, k: int, t_start: int | None = None) -> DDIMCoefficients:
+    """Precompute the affine DDIM-update coefficients for a k-strided schedule.
+
+    Deviation from the reference: when ``t+1−k < 0`` (possible for stride k not
+    dividing T−1 nicely) the reference's ``math.sqrt`` would raise; we clamp the
+    argument to 0 (ᾱ → 1, i.e. jump straight to the clean image). For every k
+    used by the reference CLIs (1, 10, 20, 50, 100) the clamp never triggers.
+    """
+    t_seq = ddim_time_sequence(total_steps, k, t_start)
+    T = float(total_steps)
+    cx = np.empty(len(t_seq), dtype=np.float64)
+    cx0 = np.empty(len(t_seq), dtype=np.float64)
+    for i, t in enumerate(t_seq):
+        a_t = 1.0 - math.sqrt((t + 1.0) / T) + ALPHA_EPS
+        a_tk = 1.0 - math.sqrt(max(t + 1.0 - k, 0.0) / T)
+        # d = √((1−a_tk)/a_tk) − √((1−a_t)/a_t)
+        d = math.sqrt((1.0 - a_tk) / a_tk) - math.sqrt((1.0 - a_t) / a_t)
+        s = math.sqrt(a_tk)
+        # x' = s·x/√a_t + s·d·noise ;  noise = x/√(1−a_t) − √a_t/√(1−a_t)·x0
+        cx[i] = s / math.sqrt(a_t) + s * d / math.sqrt(1.0 - a_t)
+        cx0[i] = -s * d * math.sqrt(a_t) / math.sqrt(1.0 - a_t)
+    return DDIMCoefficients(
+        t_seq=t_seq.astype(np.int32),
+        cx=cx.astype(np.float32),
+        cx0=cx0.astype(np.float32),
+    )
+
+
+def cold_time_sequence(levels: int = 6) -> np.ndarray:
+    """Cold-diffusion visit order t = levels..1 (reference ViT_draft2drawing.py:271)."""
+    return np.arange(levels, 0, -1, dtype=np.int32)

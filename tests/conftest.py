@@ -1,0 +1,40 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4 'distributed without a cluster').
+
+Must set XLA flags before jax is imported anywhere; pytest loads conftest
+before collecting test modules, so this is the single chokepoint.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Parity tests compare against float32 torch/numpy oracles; this JAX build's
+# default matmul precision is reduced (the TPU-friendly default the framework
+# keeps for training/bench), so pin full f32 dots for the test suite.
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture(scope="session")
+def synthetic_image_dir(tmp_path_factory):
+    """A 10-image jpg folder (the integration-test dataset, SURVEY.md §4)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("synthetic_jpgs")
+    rs = np.random.RandomState(42)
+    for i in range(10):
+        arr = rs.randint(0, 255, size=(96, 80, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / f"{i}.jpg")
+    return str(root)

@@ -1,0 +1,211 @@
+"""DiffusionViT unit tests: shapes, unpatchify round-trip, init statistics,
+time-embedding semantics, torch-oracle forward parity (torch cpu is available
+in this image as a test-only dependency)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.models import DiffusionViT
+from ddim_cold_tpu.models.init import trunc_normal
+
+TINY = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2, num_heads=4)
+
+
+def make_model(**kw):
+    cfg = dict(TINY)
+    cfg.update(kw)
+    return DiffusionViT(**cfg)
+
+
+@pytest.fixture(scope="module")
+def tiny_model_and_params():
+    model = make_model()
+    x = jnp.zeros((2, 16, 16, 3))
+    t = jnp.array([0, 5], dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t)["params"]
+    return model, params
+
+
+def test_forward_shape_and_finite(tiny_model_and_params):
+    model, params = tiny_model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 16, 3))
+    t = jnp.array([10, 100, 1999], dtype=jnp.int32)
+    out = model.apply({"params": params}, x, t)
+    assert out.shape == (3, 16, 16, 3)
+    assert out.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_param_tree_names(tiny_model_and_params):
+    """Names must stay converter-compatible with torch state_dict keys."""
+    _, params = tiny_model_and_params
+    assert set(params.keys()) == {
+        "patch_embed", "cls_token", "pos_embed", "time_embed",
+        "blocks_0", "blocks_1", "norm", "head",
+    }
+    blk = params["blocks_0"]
+    assert set(blk.keys()) == {"norm1", "attn", "norm2", "mlp"}
+    assert set(blk["attn"].keys()) == {"qkv", "proj"}
+    assert set(blk["mlp"].keys()) == {"fc1", "fc2"}
+    # shapes
+    assert params["pos_embed"].shape == (1, 2 * 2 + 1, 32)
+    assert params["time_embed"]["embedding"].shape == (2000, 32)
+    assert params["head"]["kernel"].shape == (32, 3 * 64)
+    assert blk["attn"]["qkv"]["kernel"].shape == (32, 96)
+    assert blk["attn"]["qkv"]["bias"].shape == (96,)  # qkv_bias=True default
+    # mlp_ratio=1.0 default: hidden == dim
+    assert blk["mlp"]["fc1"]["kernel"].shape == (32, 32)
+
+
+def test_unpatchify_roundtrip():
+    """Patch-extract then unpatchify must be the identity pixel mapping."""
+    model = make_model()
+    B, H, W, C, p = 2, 16, 16, 3, 8
+    img = np.random.RandomState(0).randn(B, H, W, C).astype(np.float32)
+    # patch extraction identical to PatchEmbed's reshape path
+    x = img.reshape(B, H // p, p, W // p, p, C).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(B, (H // p) * (W // p), p * p * C)
+    out = np.asarray(model.unpatchify(jnp.asarray(x)))
+    np.testing.assert_array_equal(out, img)
+
+
+def test_unpatchify_matches_torch_permute():
+    """Oracle: the reference's view/permute(0,5,1,3,2,4)/view (ViT.py:214-217)."""
+    torch = pytest.importorskip("torch")
+    B, H, W, C, p = 2, 16, 16, 3, 8
+    feat = np.random.RandomState(1).randn(B, (H // p) * (W // p), p * p * C).astype(np.float32)
+    tt = torch.from_numpy(feat)
+    ref = tt.view(-1, H // p, W // p, p, p, C).permute(0, 5, 1, 3, 2, 4).contiguous()
+    ref = ref.view(-1, C, H, W).numpy()  # NCHW
+    ours = np.asarray(make_model().unpatchify(jnp.asarray(feat)))  # NHWC
+    np.testing.assert_array_equal(ours.transpose(0, 3, 1, 2), ref)
+
+
+def test_trunc_normal_moments():
+    init = trunc_normal(std=0.02)
+    x = np.asarray(init(jax.random.PRNGKey(0), (200_000,)))
+    assert abs(x.mean()) < 1e-3
+    assert abs(x.std() - 0.02) < 1e-3
+    assert x.min() >= -2 and x.max() <= 2
+    # tight absolute bounds actually truncate
+    tight = np.asarray(trunc_normal(std=1.0, a=-0.5, b=0.5)(jax.random.PRNGKey(1), (10_000,)))
+    assert tight.min() >= -0.5 and tight.max() <= 0.5
+
+
+def test_time_embedding_changes_output(tiny_model_and_params):
+    model, params = tiny_model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 16, 3))
+    o1 = model.apply({"params": params}, x, jnp.array([3], jnp.int32))
+    o2 = model.apply({"params": params}, x, jnp.array([1500], jnp.int32))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_dropout_deterministic_vs_training(tiny_model_and_params):
+    model, params = tiny_model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16, 3))
+    t = jnp.array([7, 7], jnp.int32)
+    a = model.apply({"params": params}, x, t, deterministic=True)
+    b = model.apply({"params": params}, x, t, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = model.apply(
+        {"params": params}, x, t, deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(4)},
+    )
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_attention_probe(tiny_model_and_params):
+    model, params = tiny_model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, 16, 3))
+    t = jnp.array([0, 0], jnp.int32)
+    attn = model.apply({"params": params}, x, t, return_attention_layer=-1)
+    N = model.num_patches + 1
+    assert attn.shape == (2, 4, N, N)
+    np.testing.assert_allclose(np.asarray(attn).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_forward_parity_with_torch_oracle():
+    """Port flax params into a torch transcription of the reference model and
+    compare eval-mode forwards. Catches layout/ordering/scale drift."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+
+    E, p, img, heads, depth = 32, 8, 16, 4, 2
+    model = make_model()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, img, img, 3).astype(np.float32))
+    t = jnp.array([3, 77], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t)["params"]
+    ours = np.asarray(model.apply({"params": params}, x, t))
+
+    g = lambda *ks: np.asarray(params[ks[0]][ks[1]][ks[2]] if len(ks) == 3 else params[ks[0]][ks[1]])
+
+    class TBlock(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.norm1 = tnn.LayerNorm(E, eps=1e-5)
+            self.qkv = tnn.Linear(E, 3 * E)
+            self.proj = tnn.Linear(E, E)
+            self.norm2 = tnn.LayerNorm(E, eps=1e-5)
+            self.fc1 = tnn.Linear(E, E)
+            self.fc2 = tnn.Linear(E, E)
+
+        def forward(self, x):
+            B, N, C = x.shape
+            h = self.norm1(x)
+            qkv = self.qkv(h).reshape(B, N, 3, heads, C // heads).permute(2, 0, 3, 1, 4)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            attn = (q @ k.transpose(-2, -1)) * (C // heads) ** -0.5
+            attn = attn.softmax(dim=-1)
+            h = (attn @ v).transpose(1, 2).reshape(B, N, C)
+            x = x + self.proj(h)
+            x = x + self.fc2(torch.nn.functional.gelu(self.fc1(self.norm2(x))))
+            return x
+
+    with torch.no_grad():
+        blocks = [TBlock() for _ in range(depth)]
+        patch = tnn.Conv2d(3, E, kernel_size=p, stride=p)
+        norm = tnn.LayerNorm(E, eps=1e-5)
+        head = tnn.Linear(E, 3 * p * p)
+        # load flax params (flax Dense kernel is (in, out) = torch weight.T)
+        patch.weight.copy_(torch.from_numpy(
+            g("patch_embed", "proj", "kernel").reshape(p, p, 3, E).transpose(3, 2, 0, 1)))
+        patch.bias.copy_(torch.from_numpy(g("patch_embed", "proj", "bias")))
+        norm.weight.copy_(torch.from_numpy(g("norm", "scale")))
+        norm.bias.copy_(torch.from_numpy(g("norm", "bias")))
+        head.weight.copy_(torch.from_numpy(g("head", "kernel").T))
+        head.bias.copy_(torch.from_numpy(g("head", "bias")))
+        for i, tb in enumerate(blocks):
+            bp = params[f"blocks_{i}"]
+            tb.norm1.weight.copy_(torch.from_numpy(np.asarray(bp["norm1"]["scale"])))
+            tb.norm1.bias.copy_(torch.from_numpy(np.asarray(bp["norm1"]["bias"])))
+            tb.norm2.weight.copy_(torch.from_numpy(np.asarray(bp["norm2"]["scale"])))
+            tb.norm2.bias.copy_(torch.from_numpy(np.asarray(bp["norm2"]["bias"])))
+            tb.qkv.weight.copy_(torch.from_numpy(np.asarray(bp["attn"]["qkv"]["kernel"]).T))
+            tb.qkv.bias.copy_(torch.from_numpy(np.asarray(bp["attn"]["qkv"]["bias"])))
+            tb.proj.weight.copy_(torch.from_numpy(np.asarray(bp["attn"]["proj"]["kernel"]).T))
+            tb.proj.bias.copy_(torch.from_numpy(np.asarray(bp["attn"]["proj"]["bias"])))
+            tb.fc1.weight.copy_(torch.from_numpy(np.asarray(bp["mlp"]["fc1"]["kernel"]).T))
+            tb.fc1.bias.copy_(torch.from_numpy(np.asarray(bp["mlp"]["fc1"]["bias"])))
+            tb.fc2.weight.copy_(torch.from_numpy(np.asarray(bp["mlp"]["fc2"]["kernel"]).T))
+            tb.fc2.bias.copy_(torch.from_numpy(np.asarray(bp["mlp"]["fc2"]["bias"])))
+
+        xt = torch.from_numpy(np.asarray(x).transpose(0, 3, 1, 2))  # NCHW
+        tok = patch(xt).flatten(2).transpose(1, 2)
+        cls = torch.from_numpy(np.asarray(params["cls_token"]))
+        tok = torch.cat([cls.expand(2, -1, -1), tok], dim=1)
+        te = torch.from_numpy(np.asarray(params["time_embed"]["embedding"]))[
+            torch.tensor([3, 77])
+        ].unsqueeze(1)
+        pe = torch.from_numpy(np.asarray(params["pos_embed"]))
+        tok = tok + pe + te
+        for tb in blocks:
+            tok = tb(tok)
+        tok = head(norm(tok))
+        img_t = tok[:, 1:, :].view(-1, img // p, img // p, p, p, 3)
+        ref = img_t.permute(0, 5, 1, 3, 2, 4).contiguous().view(-1, 3, img, img).numpy()
+
+    np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), ref, rtol=2e-4, atol=2e-5)

@@ -1,0 +1,67 @@
+"""Schedule unit tests: ᾱ values and DDIM-update algebra vs a transcribed oracle."""
+
+import math
+
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.ops import schedule
+
+
+def oracle_ddim_step(x, x0, t, k, T):
+    """Literal transcription of the reference update (ViT.py:231-234)."""
+    alpha_tk = 1 - math.sqrt((t + 1 - k) / T)  # no eps
+    alpha_t = 1 - math.sqrt((t + 1) / T) + 1e-5
+    noise = (x - math.sqrt(alpha_t) * x0) / math.sqrt(1 - alpha_t)
+    return math.sqrt(alpha_tk) * (
+        x / math.sqrt(alpha_t)
+        + (math.sqrt((1 - alpha_tk) / alpha_tk) - math.sqrt((1 - alpha_t) / alpha_t)) * noise
+    )
+
+
+def test_alpha_bar_values():
+    T = 2000
+    # spot values from the closed form
+    assert schedule.alpha_bar(1999, T) == pytest.approx(1 - math.sqrt(2000 / 2000))
+    assert schedule.alpha_bar(0, T) == pytest.approx(1 - math.sqrt(1 / 2000))
+    # eps lands on the current-step variant only
+    assert schedule.alpha_bar(99, T, eps=schedule.ALPHA_EPS) == pytest.approx(
+        1 - math.sqrt(100 / 2000) + 1e-5
+    )
+
+
+def test_time_sequence_matches_range():
+    for k in (1, 10, 20, 50, 100):
+        assert schedule.ddim_time_sequence(2000, k).tolist() == list(range(1999, 0, -k))
+    # guided-sampling restart (draft2drawing t_start)
+    assert schedule.ddim_time_sequence(2000, 10, t_start=1599).tolist() == list(
+        range(1599, 0, -10)
+    )
+
+
+@pytest.mark.parametrize("k", [1, 10, 20, 50, 100])
+def test_ddim_coefficients_match_oracle(k, rng):
+    T = 2000
+    coeffs = schedule.ddim_coefficients(T, k)
+    x = rng.randn(4).astype(np.float64)
+    x0 = np.clip(rng.randn(4), -1, 1).astype(np.float64)
+    for i, t in enumerate(coeffs.t_seq):
+        want = oracle_ddim_step(x, x0, int(t), k, T)
+        got = coeffs.cx[i] * x + coeffs.cx0[i] * x0
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ddim_coefficients_clamp_negative_radicand():
+    # k=7: final t=4, t+1-k=-2 — the reference's math.sqrt would raise; we clamp.
+    coeffs = schedule.ddim_coefficients(2000, 7)
+    assert np.all(np.isfinite(coeffs.cx))
+    assert np.all(np.isfinite(coeffs.cx0))
+
+
+def test_forward_noise_alpha_no_plus_one():
+    # draft2drawing forward-noising uses t/T, not (t+1)/T (ViT_draft2drawing.py:395)
+    assert schedule.forward_noise_alpha(1600, 2000) == pytest.approx(1 - math.sqrt(0.8))
+
+
+def test_cold_time_sequence():
+    assert schedule.cold_time_sequence(6).tolist() == [6, 5, 4, 3, 2, 1]
