@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Benchmark: training throughput on the reference's one recorded config.
+
+Measures images/sec for the vit_tiny 64px cold-diffusion training step at the
+reference's effective batch 32 with AMP (bf16 compute here), and compares to
+the train.log steady state: 4.56 s / 100 steps ≈ 702 img/s on one RTX 3090
+(BASELINE.md). Runs on whatever the default JAX platform is — the real TPU
+chip under the driver.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": ..., "unit": "img/s", "vs_baseline": ...}
+
+``--smoke`` shrinks the measurement for CPU sanity runs. ``--sampler`` also
+reports DDIM k=20 sampling throughput (the north-star metric path) to stderr.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI/CPU)")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--sampler", action="store_true",
+                    help="also time DDIM k=20 sampling (stderr)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    if args.smoke:
+        args.steps = 10
+
+    model = DiffusionViT(dtype=jnp.bfloat16, **MODEL_CONFIGS["vit_tiny"])
+    rng = np.random.RandomState(0)
+    B = args.batch
+    batch = (
+        jnp.asarray(rng.randn(B, 64, 64, 3), jnp.float32),
+        jnp.asarray(rng.randn(B, 64, 64, 3), jnp.float32),
+        jnp.asarray(rng.randint(1, 7, size=(B,)), jnp.int32),
+    )
+    state = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
+                               total_steps=51200, sample_batch=batch)
+    train_step = make_train_step(model)
+    ema = jnp.float32(5.0)
+
+    # warmup / compile
+    t0 = time.time()
+    state, _, ema = train_step(state, batch, jax.random.PRNGKey(1), ema)
+    jax.block_until_ready(state.params)
+    compile_s = time.time() - t0
+    for _ in range(3):
+        state, _, ema = train_step(state, batch, jax.random.PRNGKey(1), ema)
+    jax.block_until_ready(state.params)
+
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, _, ema = train_step(state, batch, jax.random.PRNGKey(1), ema)
+    jax.block_until_ready(ema)
+    dt = time.time() - t0
+
+    img_per_sec = B * args.steps / dt
+    print(
+        f"[bench] platform={jax.default_backend()} devices={jax.device_count()} "
+        f"compile={compile_s:.1f}s {args.steps} steps in {dt:.2f}s "
+        f"({1000*dt/args.steps:.2f} ms/step)", file=sys.stderr)
+
+    if args.sampler:
+        from ddim_cold_tpu.ops import sampling
+
+        n = 8 if args.smoke else 64
+        k = 20
+        img = sampling.ddim_sample(model, state.params, jax.random.PRNGKey(2), k=k, n=n)
+        jax.block_until_ready(img)  # compile
+        t0 = time.time()
+        img = sampling.ddim_sample(model, state.params, jax.random.PRNGKey(3), k=k, n=n)
+        jax.block_until_ready(img)
+        sdt = time.time() - t0
+        print(f"[bench] DDIM k={k} N={n}: {sdt:.2f}s → {n/sdt:.1f} img/s/chip",
+              file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "train_throughput_vit_tiny64_b32",
+        "value": round(img_per_sec, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Experiment configuration — the reference's flat-YAML schema, preserved.
+
+Schema (20220822.yaml:1-15): ``initializing, resume, AMP, framework,
+num_gpus, batch_size, epoch: [start, end], base_lr, dataStorage: [train, val],
+image_size, diff_step, patch_size, embed_dim, depth, head``.
+
+Derived-value rules are part of the observable behavior (SURVEY.md quirk #7)
+and replicated exactly (multi_gpu_trainer.py:191-196):
+
+* AMP doubles the per-device batch (AMP ⇒ bf16 compute on TPU — no GradScaler
+  needed, loss scaling is a float16 artifact);
+* lr = base_lr · batch · num_devices / 512.
+
+``num_gpus`` is retained as the device-count key (it now counts TPU chips in
+the 'data' mesh axis); ``num_devices`` is accepted as an alias. ``diff_step``
+is honored — passed to the model as total_steps when ``honor_diff_step`` is
+set; by default it is recorded but the time-embedding table stays at 2000 rows
+for checkpoint compatibility (SURVEY.md quirk #4: the reference reads the key
+but never forwards it, multi_gpu_trainer.py:206 vs ViT.py:162).
+
+New optional keys (defaulted so reference YAMLs run unchanged):
+``dataset`` (cold | cold_direct | gaussian — the trainer hardwires cold,
+multi_gpu_trainer.py:5,59), ``seed``, ``honor_diff_step``, ``mesh`` (axis
+sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import yaml
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    exp_name: str
+    initializing: str = "none"
+    resume: str = "none"
+    amp: bool = False
+    framework: str = "experiment"
+    num_devices: int = 1
+    batch_size: int = 16
+    epoch: tuple[int, int] = (0, 100)
+    base_lr: float = 0.005
+    data_storage: tuple[str, str] = ("", "")
+    image_size: tuple[int, int] = (64, 64)
+    diff_step: int = 2000
+    patch_size: int = 8
+    embed_dim: int = 384
+    depth: int = 7
+    head: int = 12
+    dataset: str = "cold"
+    seed: int = 42
+    honor_diff_step: bool = False
+    mesh: Optional[dict[str, int]] = None
+
+    @property
+    def effective_batch(self) -> int:
+        """AMP doubles the batch (multi_gpu_trainer.py:191-194)."""
+        return self.batch_size * 2 if self.amp else self.batch_size
+
+    @property
+    def lr(self) -> float:
+        """base_lr · batch · devices / 512 (multi_gpu_trainer.py:196)."""
+        return self.base_lr * self.effective_batch * self.num_devices / 512.0
+
+    @property
+    def total_steps(self) -> int:
+        """Model time-embedding rows: 2000 unless diff_step is honored."""
+        return self.diff_step if self.honor_diff_step else 2000
+
+    @property
+    def run_name(self) -> str:
+        """Run dir name = <ExpName><framework> (multi_gpu_trainer.py:198)."""
+        return f"{self.exp_name}{self.framework}"
+
+    def model_kwargs(self) -> dict[str, Any]:
+        return dict(
+            img_size=tuple(self.image_size),
+            patch_size=self.patch_size,
+            embed_dim=self.embed_dim,
+            depth=self.depth,
+            num_heads=self.head,
+            total_steps=self.total_steps,
+        )
+
+
+def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentConfig:
+    """Parse a reference-schema YAML into an ExperimentConfig."""
+    with open(yaml_path) as f:
+        raw = yaml.safe_load(f)
+    name = exp_name or os.path.splitext(os.path.basename(yaml_path))[0]
+    epoch = raw.get("epoch", [0, 100])
+    return ExperimentConfig(
+        exp_name=name,
+        initializing=raw.get("initializing", "none"),
+        resume=raw.get("resume", "none"),
+        amp=bool(raw.get("AMP", raw.get("amp", False))),
+        framework=raw.get("framework", "experiment"),
+        num_devices=int(raw.get("num_devices", raw.get("num_gpus", 1))),
+        batch_size=int(raw.get("batch_size", 16)),
+        epoch=(int(epoch[0]), int(epoch[1])),
+        base_lr=float(raw.get("base_lr", 0.005)),
+        data_storage=tuple(raw.get("dataStorage", ["", ""])),
+        image_size=tuple(raw.get("image_size", [64, 64])),
+        diff_step=int(raw.get("diff_step", 2000)),
+        patch_size=int(raw.get("patch_size", 8)),
+        embed_dim=int(raw.get("embed_dim", 384)),
+        depth=int(raw.get("depth", 7)),
+        head=int(raw.get("head", 12)),
+        dataset=raw.get("dataset", "cold"),
+        seed=int(raw.get("seed", 42)),
+        honor_diff_step=bool(raw.get("honor_diff_step", False)),
+        mesh=raw.get("mesh"),
+    )

@@ -1,0 +1,8 @@
+from ddim_cold_tpu.data.datasets import (
+    ColdDownSampleDataset,
+    DiffusionDataset,
+    pil_loader,
+)
+from ddim_cold_tpu.data.loader import ShardedLoader
+
+__all__ = ["DiffusionDataset", "ColdDownSampleDataset", "ShardedLoader", "pil_loader"]

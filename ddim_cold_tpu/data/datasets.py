@@ -1,0 +1,148 @@
+"""Dataset classes — host-side image pipeline (replaces diffusion_loader.py).
+
+All three reference datasets are provided with their exact tensor contracts
+``__getitem__(index, t=None) → (noisy, target, t)`` where images are float32
+HWC in [−1, 1] (NHWC is the TPU-native layout; the torch reference is CHW).
+
+Reference quirks fixed per SURVEY.md's quirks register (do-not-copy list):
+ #1 ``ColdDownSampleDataset`` defines ``__len__`` (upstream omits it and would
+    crash DistributedSampler, diffusion_loader.py:60-97 vs :137-138);
+ #2 the index is honored — upstream ``DiffusionDataset`` overrides it with
+    ``random.randint(0,9)`` (diffusion_loader.py:44), a debug leftover.
+File listings are sorted for cross-host determinism (upstream relies on raw
+``os.listdir`` order, which is filesystem-dependent — under SPMD every host
+must agree on the index→file mapping).
+
+Per-item randomness (the step t, the Gaussian noise) is drawn from a
+``seed/epoch/index``-keyed generator so any sample is reproducible — upstream
+leaves this to worker-process global RNG state.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+from PIL import Image
+
+from ddim_cold_tpu.data import resize
+
+_IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def pil_loader(path: str) -> Image.Image:
+    """Open an image file and force RGB (reference diffusion_loader.py:17-21)."""
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def _list_images(root: str) -> list[str]:
+    names = sorted(
+        n for n in os.listdir(root) if os.path.splitext(n)[1].lower() in _IMG_EXTS
+    )
+    if not names:
+        raise FileNotFoundError(f"no image files in {root!r}")
+    return names
+
+
+def _load_base(path: str, img_size: Sequence[int]) -> np.ndarray:
+    """jpg → float32 HWC in [−1, 1]: to_tensor (÷255) → bilinear resize →
+    ·2−1 (reference diffusion_loader.py:47-49 order)."""
+    img = np.asarray(pil_loader(path), dtype=np.float32) / 255.0
+    img = resize.resize_bilinear(img, (int(img_size[0]), int(img_size[1])))
+    return img * 2.0 - 1.0
+
+
+class DiffusionDataset:
+    """Gaussian forward-noising dataset (reference diffusion_loader.py:24-58).
+
+    ``__getitem__ → (x_t, x_0, t)`` with t ~ U[0, max_step) and
+    x_t = √ᾱ·x0 + √(1−ᾱ)·ε under ᾱ = 1 − √((t+1)/T).
+    """
+
+    def __init__(self, root: str, imgSize: Sequence[int] = (32, 32), max_step: int = 2000,
+                 seed: int = 0):
+        self.root = root
+        self.img_size = tuple(int(s) for s in imgSize)
+        self.max_step = max_step
+        self.seed = seed
+        self.epoch = 0
+        self.imgList = _list_images(root)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _rng(self, index: int) -> np.random.Generator:
+        return np.random.Generator(
+            np.random.Philox(np.random.SeedSequence([self.seed, self.epoch, index, 0xD1FF]))
+        )
+
+    def __getitem__(self, index: int, t: Optional[int] = None):
+        img = _load_base(os.path.join(self.root, self.imgList[index]), self.img_size)
+        rng = self._rng(index)
+        if t is None:
+            t = int(rng.integers(self.max_step))
+        alpha = 1.0 - math.sqrt((t + 1) / self.max_step)
+        noise = rng.standard_normal(img.shape).astype(np.float32)
+        noisy = math.sqrt(alpha) * img + math.sqrt(1.0 - alpha) * noise
+        return noisy.astype(np.float32), img.astype(np.float32), t
+
+    def __len__(self) -> int:
+        return len(self.imgList)
+
+
+class ColdDownSampleDataset:
+    """Cold (downsampling) degradation dataset (reference diffusion_loader.py:60-138).
+
+    ``target_mode``:
+      * ``"chain"`` (default — what the trainer uses, multi_gpu_trainer.py:5,59):
+        returns ``(D(x,t), D(x,t−1), t)`` — one-level restoration targets.
+      * ``"direct"`` (the ``_au`` paper variant, diffusion_loader.py:99-138):
+        returns ``(D(x,t), x_0, t)`` — direct clean-image targets.
+
+    max_step = log2(size) (6 for 64px); t ∈ [1, max_step]; the degradation is
+    nearest-resize down to ⌊size/2^t⌋ then nearest back up, torch interpolate
+    index convention (data/resize.py).
+    """
+
+    def __init__(self, root: str, imgSize: Sequence[int] = (32, 32),
+                 target_mode: str = "chain", seed: int = 0):
+        if imgSize[0] != imgSize[1]:
+            raise ValueError("downsample dataset requires square images")
+        if target_mode not in ("chain", "direct"):
+            raise ValueError(f"unknown target_mode {target_mode!r}")
+        self.root = root
+        self.img_size = tuple(int(s) for s in imgSize)
+        self.size = int(imgSize[0])
+        self.max_step = int(np.log2(self.size))
+        self.target_mode = target_mode
+        self.seed = seed
+        self.epoch = 0
+        self.imgList = _list_images(root)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def get_t(self, img: np.ndarray, level_scale: int) -> np.ndarray:
+        """D(x, s) for s = 2^t (reference diffusion_loader.py:79-83)."""
+        return resize.cold_degrade(img, level_scale, self.size)
+
+    def __getitem__(self, index: int, t: Optional[int] = None):
+        img = _load_base(os.path.join(self.root, self.imgList[index]), self.img_size)
+        if t is None:
+            rng = np.random.Generator(
+                np.random.Philox(np.random.SeedSequence([self.seed, self.epoch, index, 0xC01D]))
+            )
+            t = int(rng.integers(self.max_step)) + 1  # t ∈ [1, max_step]
+        noisy_t = self.get_t(img, 2**t)
+        if self.target_mode == "chain":
+            target = self.get_t(img, 2 ** (t - 1))
+        else:
+            target = img
+        return noisy_t.astype(np.float32), target.astype(np.float32), t
+
+    def __len__(self) -> int:
+        return len(self.imgList)

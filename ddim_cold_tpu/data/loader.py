@@ -1,0 +1,168 @@
+"""Per-host sharded batching — replaces DataLoader + DistributedSampler.
+
+Under SPMD there is one process per host (not per chip), so the reference's
+two process boundaries (mp.spawn rank procs + 8 DataLoader workers each,
+multi_gpu_trainer.py:63,212-219) collapse into this loader: each host decodes
+only its shard of the global index order and feeds a host-local numpy batch;
+pjit/shard_map then treats the per-host batches as one global batch sharded on
+the 'data' mesh axis.
+
+Sharding semantics mirror torch DistributedSampler exactly
+(multi_gpu_trainer.py:61-64):
+
+* train: per-epoch permutation from seed 42 (+epoch), drop_last — the global
+  sample count is ⌊len/world⌋·world and shard r takes indices [r::world];
+* eval: no shuffle, wrap-around (tiled) padding so every shard sees the same
+  batch count even when the dataset is smaller than the shard count (torch
+  tiles its index list the same way; upstream eval divides by the padded
+  count, we keep that). ``pad_final_batch`` additionally rounds the LAST
+  batch up to full size by wrapping — required because batches are placed
+  with their leading dim sharded over the 'data' mesh axis, which needs even
+  divisibility (a GPU ragged tail has no SPMD equivalent); the duplicate
+  samples bias the epoch-mean val loss negligibly and deterministically.
+
+Decode is overlapped with device compute by a thread pool that parallelizes
+*within* a batch plus a bounded prefetch queue, so at most ``prefetch + 1``
+decoded batches exist at any time regardless of dataset size (PIL decode
+releases the GIL; this replaces the reference's 8 worker processes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class ShardedLoader:
+    """Iterable over host-local batches of ``(noisy, target, t)`` numpy arrays."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        shuffle: bool,
+        seed: int = 42,
+        drop_last: bool = True,
+        shard_index: int = 0,
+        shard_count: int = 1,
+        num_threads: int = 8,
+        prefetch: int = 2,
+        pad_final_batch: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.shard_index = shard_index
+        self.shard_count = shard_count
+        self.num_threads = num_threads
+        self.prefetch = prefetch
+        self.pad_final_batch = pad_final_batch
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the epoch shuffle (mirrors DistributedSampler.set_epoch)."""
+        self.epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def _shard_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            indices = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        else:
+            indices = np.arange(n)
+        world = self.shard_count
+        if self.drop_last:
+            total = (n // world) * world
+            indices = indices[:total]
+        else:
+            total = -(-n // world) * world  # ceil to a multiple of world
+            if total > n:
+                indices = np.resize(indices, total)  # tiled wrap-around pad
+        return indices[self.shard_index :: world]
+
+    def __len__(self) -> int:
+        per_shard = len(self._shard_indices())
+        if self.drop_last:
+            return per_shard // self.batch_size
+        return -(-per_shard // self.batch_size)
+
+    def _batches(self) -> list[np.ndarray]:
+        indices = self._shard_indices()
+        nb = len(self)
+        if self.pad_final_batch and nb * self.batch_size > len(indices):
+            indices = np.resize(indices, nb * self.batch_size)
+        return [indices[i * self.batch_size : (i + 1) * self.batch_size]
+                for i in range(nb)]
+
+    def _collate(self, items):
+        noisy = np.stack([it[0] for it in items])
+        target = np.stack([it[1] for it in items])
+        t = np.asarray([it[2] for it in items], dtype=np.int32)
+        return noisy, target, t
+
+    def _make_batch(self, idxs: np.ndarray, pool: Optional[ThreadPoolExecutor] = None):
+        if pool is None:
+            items = [self.dataset[int(i)] for i in idxs]
+        else:
+            items = list(pool.map(self.dataset.__getitem__, [int(i) for i in idxs]))
+        return self._collate(items)
+
+    def __iter__(self) -> Iterator:
+        batches = self._batches()
+        if self.num_threads <= 1:
+            for b in batches:
+                yield self._make_batch(b)
+            return
+
+        # one producer thread decodes batch-by-batch (items fan out over the
+        # pool); the bounded queue caps live memory at prefetch+1 batches and
+        # an abandoned iterator stops decoding within one batch.
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self.num_threads) as pool:
+                    for b in batches:
+                        if stop.is_set() or not put(self._make_batch(b, pool)):
+                            return
+                put(None)
+            except BaseException as e:  # surface decode errors to the consumer
+                put(e)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # unblock a producer waiting on a full queue, then reap it
+            while thread.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.2)

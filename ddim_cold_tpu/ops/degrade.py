@@ -1,0 +1,49 @@
+"""Device-side cold degradation operator D(x, t) — the jittable twin of
+data/resize.py's host pipeline.
+
+Index math is identical to the host path (torch interpolate-nearest
+convention: src = floor(dst · in/out)), so host-prepared training targets and
+on-device degradations agree bit-for-bit — the golden-test in
+tests/test_degrade.py pins this.
+
+Down-then-up nearest resize composes into a single gather per axis:
+``idx[i] = down_idx[up_idx[i]]``; each level is a static gather and a traced
+per-sample ``t`` selects between levels via ``lax.switch`` under ``vmap``
+(compiler-friendly — no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.data.resize import nearest_indices
+
+
+def _level_indices(size: int, level: int) -> np.ndarray:
+    """Composed gather indices for one degradation level (2^level)."""
+    target = max(int(np.floor(size / (2**level))), 1)
+    down = nearest_indices(target, size)  # small ← big
+    up = nearest_indices(size, target)  # big ← small
+    return down[up]
+
+
+@partial(jax.jit, static_argnames=("size", "max_step"))
+def cold_degrade(imgs: jax.Array, t: jax.Array, *, size: int, max_step: int = 6) -> jax.Array:
+    """D(x, t) for a batch: (B, H, W, C) float, per-sample int t ∈ [0, max_step].
+
+    t=0 is the identity (the reference's D(x, 2^0) — two identity resizes,
+    diffusion_loader.py:94-95 with t−1=0).
+    """
+    tables = jnp.asarray(
+        np.stack([_level_indices(size, lv) for lv in range(max_step + 1)])
+    )  # (levels+1, size)
+
+    def one(img, ti):
+        idx = tables[ti]
+        return img[idx][:, idx]
+
+    return jax.vmap(one)(imgs, t.astype(jnp.int32))

@@ -1,0 +1,12 @@
+"""Losses. The reference trains and evaluates exclusively with mean
+smooth-L1 (Huber, beta=1) — ``F.smooth_l1_loss`` at multi_gpu_trainer.py:43,124."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, beta: float = 1.0) -> jnp.ndarray:
+    """Mean smooth-L1: 0.5·d²/beta for |d| < beta, |d| − 0.5·beta otherwise."""
+    d = jnp.abs(pred.astype(jnp.float32) - target.astype(jnp.float32))
+    return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))

@@ -1,0 +1,167 @@
+"""Samplers — the inference "scheduler" layer, as jitted ``lax.scan`` loops.
+
+Replaces the reference's Python-loop samplers (methods on the torch model):
+
+* ``ddim_sample``      ← ``sampler``             (reference ViT.py:220-237)
+* ``ddim_sample(..., return_sequence=True)``
+                       ← ``diffusion_sequence``  (reference ViT.py:239-256)
+* ``cold_sample``      ← ``cold_sampler``        (reference ViT_draft2drawing.py:259-288)
+* ``cold_sample(..., return_sequence=True)``
+                       ← ``cold_diffusion_sequence`` (reference ViT_draft2drawing.py:290-309)
+* ``sample_from``      ← the draft2drawing inner loop (reference
+                          ViT_draft2drawing.py:394-408) — DDIM from an
+                          arbitrary start level, the guided-sampling primitive
+                          that also expresses slerp interpolation (C25)
+* ``forward_noise``    ← ``√(1−ᾱ)·ε + √ᾱ·x`` encoding (ViT_draft2drawing.py:395-396)
+
+Design: each reverse step is affine in (x, x̂0) — the per-step coefficients are
+precomputed host-side (ops/schedule.py) and fed to a single ``lax.scan`` whose
+body is one model forward + clamp + two fused multiply-adds. There is no
+host↔device traffic until the final gather; k, N, T are static so XLA compiles
+one program per (model, stride) pair. The reference's per-step ``print`` timing
+is replaced by ``jax.profiler`` tracing (utils/profiling.py).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ddim_cold_tpu.ops import schedule
+
+
+def forward_noise(rng: jax.Array, img: jax.Array, t_start: int, total_steps: int = 2000):
+    """Encode a clean image to noise level ``t_start``.
+
+    ᾱ here is ``1 − √(t_start/T)`` — no +1, matching the draft2drawing app
+    (reference ViT_draft2drawing.py:395), not the sampler's ``(t+1)/T``.
+    """
+    alpha = schedule.forward_noise_alpha(t_start, total_steps)
+    eps = jax.random.normal(rng, img.shape, img.dtype)
+    return math.sqrt(alpha) * img + math.sqrt(1.0 - alpha) * eps
+
+
+@partial(jax.jit, static_argnames=("model", "k", "t_start"))
+def _ddim_scan_sequence(model, params, x_init, *, k: int, t_start: Optional[int]):
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start)
+    n = x_init.shape[0]
+
+    def step(x, inputs):
+        t, c1, c2 = inputs
+        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        return c1 * x + c2 * x0, x0
+
+    _, x0_out = jax.lax.scan(
+        step, x_init, (jnp.asarray(coeffs.t_seq), jnp.asarray(coeffs.cx), jnp.asarray(coeffs.cx0))
+    )
+    # frames: the initial noisy image, then every x̂0 prediction — matching the
+    # reference's recorded trajectory (ViT.py:244,254).
+    frames = jnp.concatenate([x_init[None], x0_out], axis=0)
+    return (frames + 1.0) / 2.0
+
+
+@partial(jax.jit, static_argnames=("model", "k", "t_start"))
+def _ddim_scan_last(model, params, x_init, *, k: int, t_start: Optional[int]):
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, _ = carry
+        t, c1, c2 = inputs
+        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        return (c1 * x + c2 * x0, x0), None
+
+    (_, x0_last), _ = jax.lax.scan(
+        step,
+        (x_init, jnp.zeros_like(x_init)),
+        (jnp.asarray(coeffs.t_seq), jnp.asarray(coeffs.cx), jnp.asarray(coeffs.cx0)),
+    )
+    # the sample is the LAST x̂0 prediction, not the final noisy state
+    # (reference ViT.py:236 returns denoised_img).
+    return (x0_last + 1.0) / 2.0
+
+
+def ddim_sample(
+    model,
+    params,
+    rng: Optional[jax.Array] = None,
+    *,
+    k: int = 10,
+    n: int = 128,
+    x_init: Optional[jax.Array] = None,
+    t_start: Optional[int] = None,
+    return_sequence: bool = False,
+) -> jax.Array:
+    """k-strided DDIM sampling; returns images in [0, 1], NHWC.
+
+    Either pass ``rng`` (fresh N(0,1) start, reference ViT.py:224) or
+    ``x_init`` (an already-encoded image — the guided path). Defaults mirror
+    the reference API (k=10, N=128, ViT.py:221).
+
+    ``return_sequence=True`` returns the (n_steps+1, N, H, W, C) trajectory of
+    the initial noise plus every x̂0 prediction (the denoise-sequence figure).
+    """
+    if x_init is None:
+        if rng is None:
+            raise ValueError("ddim_sample needs either rng or x_init")
+        H, W = model.img_size
+        x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    if return_sequence:
+        return _ddim_scan_sequence(model, params, x_init, k=k, t_start=t_start)
+    return _ddim_scan_last(model, params, x_init, k=k, t_start=t_start)
+
+
+def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10) -> jax.Array:
+    """Guided sampling: DDIM-denoise an encoded image from level ``t_start``.
+
+    Strictly a prefix-truncated ``ddim_sample`` (SURVEY.md C24). The
+    draft2drawing app composes this with ``forward_noise``; slerp interpolation
+    (C25) composes it with a spherical mix of two encodings.
+    """
+    return ddim_sample(model, params, x_init=x_init, t_start=t_start, k=k)
+
+
+@partial(jax.jit, static_argnames=("model", "levels", "return_sequence"))
+def _cold_scan(model, params, x_init, *, levels: int, return_sequence: bool):
+    t_seq = jnp.asarray(schedule.cold_time_sequence(levels))
+    n = x_init.shape[0]
+
+    def step(x, t):
+        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        # naive Cold-Diffusion Algorithm 1: x ← clamp(f(x, t)); the reference's
+        # DDIM-style correction is present upstream only as commented-out code
+        # (ViT_draft2drawing.py:275-285).
+        return x0, x0 if return_sequence else None
+
+    x_last, frames = jax.lax.scan(step, x_init, t_seq)
+    if return_sequence:
+        return (jnp.concatenate([x_init[None], frames], axis=0) + 1.0) / 2.0
+    return (x_last + 1.0) / 2.0
+
+
+def cold_sample(
+    model,
+    params,
+    rng: jax.Array,
+    *,
+    n: int = 49,
+    levels: int = 6,
+    return_sequence: bool = False,
+) -> jax.Array:
+    """Cold-diffusion sampling from per-sample constant-color "noise".
+
+    The init is a single N(0,1) RGB color per sample broadcast over the image
+    (reference ViT_draft2drawing.py:264 — the fully-downsampled degenerate
+    state); ``levels`` defaults to 6 = log2(64).
+    """
+    H, W = model.img_size
+    color = jax.random.normal(rng, (n, 1, 1, model.in_chans), jnp.float32)
+    x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
+    return _cold_scan(model, params, x_init, levels=levels, return_sequence=return_sequence)

@@ -1,0 +1,19 @@
+from ddim_cold_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+    shard_params,
+    shard_train_state,
+)
+from ddim_cold_tpu.parallel.sharding import param_partition_specs
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "shard_params",
+    "shard_train_state",
+    "param_partition_specs",
+]

@@ -1,0 +1,111 @@
+"""Mesh/communication layer — replaces the reference's NCCL/DDP stack (C17).
+
+The reference runs one OS process per GPU, rendezvouses over TCP
+(multi_gpu_trainer.py:25-30), wraps the model in DDP for ring-allreduce of
+gradients, and shards data with DistributedSampler. Under JAX SPMD all of that
+collapses: one process per *host*, a ``jax.sharding.Mesh`` over the chips,
+sharding annotations on params/batch, and XLA emits the collectives (psum for
+gradients over ICI, all-gather where layouts require) fused into the step.
+
+Mesh axes:
+* ``data``  — batch (data parallelism; gradient psum is implicit in jit)
+* ``model`` — attention heads / MLP hidden (Megatron-style tensor parallelism)
+
+Multi-host: call ``initialize_distributed()`` once per host before device
+queries; each host then feeds its data shard (data/loader.py shard_index =
+``jax.process_index()``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_distributed(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host process coordination over DCN (replaces the TCP rendezvous at
+    multi_gpu_trainer.py:25-30). No-op for single-host runs."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num_processes, process_id=process_id
+    )
+
+
+def make_mesh(shape: Optional[dict[str, int]] = None, devices=None) -> Mesh:
+    """Build a Mesh. Default: every visible device on the 'data' axis with a
+    trivial 'model' axis, so dp-only configs and tp-aware code share one layout.
+
+    ``shape`` e.g. ``{"data": 4, "model": 2}`` must multiply to the device
+    count (axis order = dict order, data-major outermost so model groups are
+    ICI-adjacent).
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"data": devices.size, "model": 1}
+    sizes = tuple(shape.values())
+    if int(np.prod(sizes)) != devices.size:
+        raise ValueError(f"mesh shape {shape} does not match {devices.size} devices")
+    return Mesh(devices.reshape(sizes), tuple(shape.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch arrays shard their leading dim over 'data' (DistributedSampler's
+    role, now expressed as a sharding annotation)."""
+    return NamedSharding(mesh, P("data"))
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host-local batch as a global array sharded on 'data'.
+
+    Multi-host: each process contributes its shard of the global batch
+    (``make_array_from_process_local_data`` — the SPMD replacement for
+    DistributedSampler rank interleaving)."""
+    s = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(s, np.asarray(x)), batch
+        )
+    return jax.tree.map(lambda x: jax.device_put(x, s), batch)
+
+
+def shard_params(params, mesh: Mesh, specs=None):
+    """Place params on the mesh: replicated by default, or per-leaf
+    PartitionSpecs (parallel/sharding.py) for tensor parallelism."""
+    if specs is None:
+        return jax.device_put(params, replicated(mesh))
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), params, specs
+    )
+
+
+def shard_train_state(state, mesh: Mesh, specs=None):
+    """Place a TrainState on the mesh: params per ``specs`` (or replicated),
+    optimizer moments co-sharded with their params.
+
+    The optimizer-state layout is derived by re-running ``tx.init`` on the
+    *already-sharded* params — optax moments are ``zeros_like(params)`` so they
+    inherit the param shardings — and restored/initial values are then placed
+    leaf-by-leaf onto that layout. Keeps Adam's mu/nu from silently living
+    replicated next to tensor-sharded params (2× HBM + a reshard per step).
+    """
+    params = shard_params(state.params, mesh, specs)
+    layout = state.tx.init(params)
+    mesh_devices = set(mesh.devices.flat)
+
+    def place(value, ref):
+        sharding = ref.sharding
+        if getattr(sharding, "device_set", None) != mesh_devices:
+            sharding = replicated(mesh)  # scalars (e.g. adam count) from init
+        return jax.device_put(np.asarray(value), sharding)
+
+    opt_state = jax.tree.map(place, state.opt_state, layout)
+    return state.replace(params=params, opt_state=opt_state)

@@ -1,0 +1,44 @@
+"""Tensor-parallel partition specs for DiffusionViT parameters.
+
+Megatron-style column→row sharding per transformer block over the 'model'
+mesh axis:
+
+* qkv kernel   (E, 3E): split the fused output dim  → P(None, 'model')
+  (heads are the true unit — 3E reshapes to (3, H, hd), so 'model' must
+  divide num_heads);
+* attn proj    (E, E):  split the input dim          → P('model', None);
+  XLA closes the pair with one reduce-scatter/all-reduce over ICI;
+* mlp fc1      (E, hE): split the hidden dim         → P(None, 'model');
+* mlp fc2      (hE, E): split the input dim          → P('model', None);
+* sharded-dim biases follow their kernel; everything else (embeddings,
+  layernorms, head, cls/pos/time tables) is replicated.
+
+The reference has NO tensor parallelism (SURVEY.md C17: DP is the only
+parallelism present); this layer is the TPU-native scale-out beyond parity.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+_COL_KERNELS = ("qkv", "fc1")  # output-dim sharded
+_ROW_KERNELS = ("proj", "fc2")  # input-dim sharded
+
+
+def _spec_for(path: tuple[str, ...]) -> P:
+    names = [getattr(k, "key", str(k)) for k in path]
+    if "patch_embed" in names:
+        return P()  # keep the token projection replicated (small, bandwidth-bound)
+    leaf = names[-1]
+    module = names[-2] if len(names) >= 2 else ""
+    if module in _COL_KERNELS:
+        return P(None, "model") if leaf == "kernel" else P("model")
+    if module in _ROW_KERNELS:
+        return P("model", None) if leaf == "kernel" else P()
+    return P()
+
+
+def param_partition_specs(params):
+    """PyTree of PartitionSpecs matching ``params``' structure."""
+    return jax.tree_util.tree_map_with_path(lambda path, _: _spec_for(path), params)

@@ -1,0 +1,3 @@
+from ddim_cold_tpu.train.step import create_train_state, make_eval_step, make_train_step
+
+__all__ = ["create_train_state", "make_train_step", "make_eval_step"]

@@ -1,0 +1,87 @@
+"""The SPMD training step — replaces DDP + AMP + GradScaler + per-step
+scheduler (SURVEY.md C16).
+
+One jitted ``train_step(state, batch, rng) → (state, loss)`` carries the whole
+reference inner loop (multi_gpu_trainer.py:109-134): forward in the model's
+compute dtype (bf16 under "AMP" — no GradScaler; bf16 keeps fp32 range so loss
+scaling is unnecessary on TPU), smooth-L1 loss in f32, global-norm clip 1.0,
+AdamW(wd=0.05) with a per-step cosine schedule to 0 — the optax chain mirrors
+torch's clip→AdamW→CosineAnnealingLR order of operations.
+
+Parallelism is carried by the *data*, not the code: params live replicated (or
+tensor-sharded) on the mesh, the batch is sharded on 'data', and XLA inserts
+the gradient psum over ICI where DDP used an NCCL allreduce. The same step
+function serves 1 chip or a full slice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from ddim_cold_tpu.ops.losses import smooth_l1
+
+
+def make_optimizer(lr: float, total_steps: int) -> optax.GradientTransformation:
+    """clip_by_global_norm(1.0) → AdamW(cosine→0, wd=0.05)
+    (multi_gpu_trainer.py:89-92,130)."""
+    schedule = optax.cosine_decay_schedule(init_value=lr, decay_steps=total_steps, alpha=0.0)
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.05),
+    )
+
+
+def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
+                       sample_batch) -> train_state.TrainState:
+    """Initialize params (same rng on every host ⇒ identical init, making the
+    reference's save-to-file-and-sleep broadcast (multi_gpu_trainer.py:71-80)
+    unnecessary) and wrap them with the optimizer."""
+    noisy, _, t = sample_batch
+    params = model.init(rng, jnp.asarray(noisy), jnp.asarray(t))["params"]
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer(lr, total_steps)
+    )
+
+
+def make_train_step(model) -> Callable:
+    """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
+
+    The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
+    device scalar so the host only syncs at log points — the reference's
+    per-step ``loss.item()`` would serialize the TPU pipeline. State buffers
+    are donated (in-place update, no double-buffered params in HBM).
+    """
+
+    @partial(jax.jit, donate_argnums=(0, 3))
+    def train_step(state: train_state.TrainState, batch, rng: jax.Array,
+                   loss_rec: jax.Array):
+        noisy, target, t = batch
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            pred = model.apply(
+                {"params": params}, noisy, t, deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return smooth_l1(pred, target)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss, loss_rec * 0.99 + loss * 0.01
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable:
+    @jax.jit
+    def eval_step(params, batch):
+        noisy, target, t = batch
+        pred = model.apply({"params": params}, noisy, t, deterministic=True)
+        return smooth_l1(pred, target)
+
+    return eval_step

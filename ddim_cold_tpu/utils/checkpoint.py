@@ -1,0 +1,175 @@
+"""Checkpointing — orbax for native state, plus a torch-pickle bridge.
+
+Reproduces the reference's dual-checkpoint behavior (SURVEY.md C20,
+multi_gpu_trainer.py:94-106,152-163):
+
+* ``bestloss`` — bare model weights whenever val improves;
+* ``lastepoch`` — full training state (epoch, steps, EMA loss, best metric,
+  params, optimizer state) every epoch, the resume target.
+
+Native format is orbax (one directory per checkpoint). The legacy ``*.pkl``
+bridge converts between torch state_dicts (``blocks.N.attn.qkv.weight``…) and
+the Flax param tree so reference checkpoints load here and vice versa; torch
+(cpu) is an optional conversion-time dependency only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# torch state_dict ↔ flax params
+# ---------------------------------------------------------------------------
+
+def _strip_ddp_prefix(state_dict: dict) -> dict:
+    """lastepoch state_dicts carry DDP's 'module.' prefix (multi_gpu_trainer.py:160)."""
+    return {re.sub(r"^module\.", "", k): v for k, v in state_dict.items()}
+
+
+def flax_from_torch_state_dict(state_dict: dict, patch_size: int) -> dict:
+    """Map a reference torch state_dict to the DiffusionViT param tree.
+
+    Layout transforms: Linear ``W (out,in)`` → kernel ``(in,out)``; the patch
+    Conv2d ``W (E,C,p,p)`` → Dense kernel ``(p²C, E)`` with (row, col, chan)
+    patch-feature order (models/vit.py PatchEmbed docstring); LayerNorm
+    weight → scale.
+    """
+    sd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v,
+                        dtype=np.float32)
+          for k, v in _strip_ddp_prefix(state_dict).items()}
+    p = patch_size
+    params: dict[str, Any] = {
+        "cls_token": sd["cls_token"],
+        "pos_embed": sd["pos_embed"],
+        "time_embed": {"embedding": sd["time_embed.weight"]},
+        "norm": {"scale": sd["norm.weight"], "bias": sd["norm.bias"]},
+        "head": {"kernel": sd["head.weight"].T, "bias": sd["head.bias"]},
+    }
+    w = sd["patch_embed.proj.weight"]  # (E, C, p, p)
+    e = w.shape[0]
+    params["patch_embed"] = {
+        "proj": {
+            "kernel": w.transpose(2, 3, 1, 0).reshape(p * p * w.shape[1], e),
+            "bias": sd["patch_embed.proj.bias"],
+        }
+    }
+    depth = 1 + max(
+        int(m.group(1)) for k in sd if (m := re.match(r"blocks\.(\d+)\.", k))
+    )
+    for i in range(depth):
+        b = f"blocks.{i}."
+        params[f"blocks_{i}"] = {
+            "norm1": {"scale": sd[b + "norm1.weight"], "bias": sd[b + "norm1.bias"]},
+            "norm2": {"scale": sd[b + "norm2.weight"], "bias": sd[b + "norm2.bias"]},
+            "attn": {
+                "qkv": {"kernel": sd[b + "attn.qkv.weight"].T,
+                        **({"bias": sd[b + "attn.qkv.bias"]}
+                           if b + "attn.qkv.bias" in sd else {})},
+                "proj": {"kernel": sd[b + "attn.proj.weight"].T,
+                         "bias": sd[b + "attn.proj.bias"]},
+            },
+            "mlp": {
+                "fc1": {"kernel": sd[b + "mlp.fc1.weight"].T, "bias": sd[b + "mlp.fc1.bias"]},
+                "fc2": {"kernel": sd[b + "mlp.fc2.weight"].T, "bias": sd[b + "mlp.fc2.bias"]},
+            },
+        }
+    return params
+
+
+def torch_state_dict_from_flax(params, patch_size: int) -> dict:
+    """Inverse of ``flax_from_torch_state_dict`` (numpy arrays, torch-key names)."""
+    g = lambda *ks: np.asarray(_dig(params, ks))
+    p = patch_size
+    pk = g("patch_embed", "proj", "kernel")  # (p²C, E)
+    e = pk.shape[1]
+    c = pk.shape[0] // (p * p)
+    sd = {
+        "cls_token": g("cls_token"),
+        "pos_embed": g("pos_embed"),
+        "time_embed.weight": g("time_embed", "embedding"),
+        "patch_embed.proj.weight": pk.reshape(p, p, c, e).transpose(3, 2, 0, 1),
+        "patch_embed.proj.bias": g("patch_embed", "proj", "bias"),
+        "norm.weight": g("norm", "scale"),
+        "norm.bias": g("norm", "bias"),
+        "head.weight": g("head", "kernel").T,
+        "head.bias": g("head", "bias"),
+    }
+    i = 0
+    while f"blocks_{i}" in params:
+        b = f"blocks_{i}"
+        sd[f"blocks.{i}.norm1.weight"] = g(b, "norm1", "scale")
+        sd[f"blocks.{i}.norm1.bias"] = g(b, "norm1", "bias")
+        sd[f"blocks.{i}.norm2.weight"] = g(b, "norm2", "scale")
+        sd[f"blocks.{i}.norm2.bias"] = g(b, "norm2", "bias")
+        sd[f"blocks.{i}.attn.qkv.weight"] = g(b, "attn", "qkv", "kernel").T
+        if "bias" in params[b]["attn"]["qkv"]:
+            sd[f"blocks.{i}.attn.qkv.bias"] = g(b, "attn", "qkv", "bias")
+        sd[f"blocks.{i}.attn.proj.weight"] = g(b, "attn", "proj", "kernel").T
+        sd[f"blocks.{i}.attn.proj.bias"] = g(b, "attn", "proj", "bias")
+        sd[f"blocks.{i}.mlp.fc1.weight"] = g(b, "mlp", "fc1", "kernel").T
+        sd[f"blocks.{i}.mlp.fc1.bias"] = g(b, "mlp", "fc1", "bias")
+        sd[f"blocks.{i}.mlp.fc2.weight"] = g(b, "mlp", "fc2", "kernel").T
+        sd[f"blocks.{i}.mlp.fc2.bias"] = g(b, "mlp", "fc2", "bias")
+        i += 1
+    return sd
+
+
+def _dig(tree, keys):
+    for k in keys:
+        tree = tree[k]
+    return tree
+
+
+def load_torch_pkl(path: str, patch_size: int) -> dict:
+    """Load a reference ``*.pkl`` (bare state_dict or the lastepoch dict) into
+    a Flax param tree. Requires torch at conversion time only."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return flax_from_torch_state_dict(obj, patch_size)
+
+
+def save_torch_pkl(params, path: str, patch_size: int) -> None:
+    """Write params as a torch state_dict pickle a reference user can load."""
+    import torch
+
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in torch_state_dict_from_flax(params, patch_size).items()}
+    torch.save(sd, path)
+
+
+# ---------------------------------------------------------------------------
+# orbax train-state checkpoints
+# ---------------------------------------------------------------------------
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, tree) -> None:
+    """Save a pytree checkpoint directory (orbax)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, _to_host(tree), force=True)
+
+
+def restore_checkpoint(path: str, target=None):
+    """Restore a pytree checkpoint; ``target`` fixes structure/dtypes."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is None:
+        return ckptr.restore(os.path.abspath(path))
+    return ckptr.restore(
+        os.path.abspath(path), args=ocp.args.PyTreeRestore(item=_to_host(target))
+    )

@@ -1,0 +1,45 @@
+"""Image grid rendering + output-path helper (reference CLI surface, C23).
+
+``save_grid`` replaces the matplotlib ImageGrid figures (ViT.py:283-305) with
+a direct PIL tiling — no matplotlib dependency on TPU hosts, same artifact.
+``get_next_path`` fixes the reference's infinite loop (ViT.py:307-313 never
+increments ``i``; SURVEY.md quirk #3).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def to_uint8(img: np.ndarray) -> np.ndarray:
+    """[0,1] float HWC → uint8."""
+    return (np.clip(np.asarray(img), 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
+
+
+def save_grid(images: np.ndarray, path: str, *, nrows: int, ncols: int, pad: int = 2) -> str:
+    """Tile (N, H, W, C) images in [0,1] into an nrows×ncols grid PNG."""
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    canvas = np.full(
+        (nrows * h + (nrows - 1) * pad, ncols * w + (ncols - 1) * pad, c), 255, np.uint8
+    )
+    for idx in range(min(n, nrows * ncols)):
+        r, col = divmod(idx, ncols)
+        y, x = r * (h + pad), col * (w + pad)
+        canvas[y : y + h, x : x + w] = to_uint8(images[idx])
+    Image.fromarray(canvas.squeeze()).save(path)
+    return path
+
+
+def get_next_path(pth: str) -> str:
+    """First non-existing ``<stem>_<i><ext>`` (reference intent, loop fixed)."""
+    prefix, ext = os.path.splitext(pth)
+    i = 1
+    file_path = pth
+    while os.path.isfile(file_path):
+        file_path = f"{prefix}_{i}{ext}"
+        i += 1
+    return file_path

@@ -6,12 +6,18 @@ before collecting test modules, so this is the single chokepoint.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: this environment pre-sets JAX_PLATFORMS=axon (TPU tunnel) and the
+# config survives env-var overrides — the jax.config.update below is the one
+# that actually forces CPU. The XLA flag must still be set pre-import to get
+# the 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Parity tests compare against float32 torch/numpy oracles; this JAX build's
 # default matmul precision is reduced (the TPU-friendly default the framework

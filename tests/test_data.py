@@ -1,0 +1,254 @@
+"""Data layer tests: resize oracles vs torch interpolate, dataset contracts,
+degradation parity (host vs device), sharded-loader semantics."""
+
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.data import ColdDownSampleDataset, DiffusionDataset, ShardedLoader
+from ddim_cold_tpu.data import resize
+
+
+# ---------- resize oracles ----------
+
+@pytest.mark.parametrize("inout", [(64, 8), (64, 64), (96, 64), (13, 7), (8, 64)])
+def test_resize_nearest_matches_torch(inout, rng):
+    torch = pytest.importorskip("torch")
+    size_in, size_out = inout
+    img = rng.rand(size_in, size_in, 3).astype(np.float32)
+    want = (
+        torch.nn.functional.interpolate(
+            torch.from_numpy(img.transpose(2, 0, 1))[None], size=(size_out, size_out),
+            mode="nearest",
+        )[0].numpy().transpose(1, 2, 0)
+    )
+    got = resize.resize_nearest(img, (size_out, size_out))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("inout", [(96, 64), (80, 64), (64, 200), (50, 64)])
+def test_resize_bilinear_matches_torch(inout, rng):
+    torch = pytest.importorskip("torch")
+    size_in, size_out = inout
+    img = rng.rand(size_in, size_in, 3).astype(np.float32)
+    want = (
+        torch.nn.functional.interpolate(
+            torch.from_numpy(img.transpose(2, 0, 1))[None], size=(size_out, size_out),
+            mode="bilinear", align_corners=False, antialias=False,
+        )[0].numpy().transpose(1, 2, 0)
+    )
+    got = resize.resize_bilinear(img, (size_out, size_out))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cold_degrade_golden(rng):
+    """D(x,s) = nearest down to floor(size/s) then nearest up (the operator the
+    trainer's targets are built from)."""
+    torch = pytest.importorskip("torch")
+    img = rng.rand(64, 64, 3).astype(np.float32)
+    for t in range(1, 7):
+        s = 2**t
+        target = int(np.floor(64 / s))
+        tt = torch.from_numpy(img.transpose(2, 0, 1))[None]
+        small = torch.nn.functional.interpolate(tt, size=(target, target), mode="nearest")
+        big = torch.nn.functional.interpolate(small, size=(64, 64), mode="nearest")
+        want = big[0].numpy().transpose(1, 2, 0)
+        got = resize.cold_degrade(img, s, 64)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_device_degrade_matches_host(rng):
+    import jax.numpy as jnp
+
+    from ddim_cold_tpu.ops.degrade import cold_degrade as device_degrade
+
+    imgs = rng.rand(7, 64, 64, 3).astype(np.float32)
+    ts = np.array([0, 1, 2, 3, 4, 5, 6], dtype=np.int32)
+    got = np.asarray(device_degrade(jnp.asarray(imgs), jnp.asarray(ts), size=64))
+    for i, t in enumerate(ts):
+        want = resize.cold_degrade(imgs[i], 2 ** int(t), 64)
+        np.testing.assert_array_equal(got[i], want)
+
+
+# ---------- datasets ----------
+
+def test_cold_dataset_contract(synthetic_image_dir):
+    ds = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64])
+    assert len(ds) == 10  # quirk #1 fixed: __len__ exists
+    assert ds.max_step == 6
+    noisy, target, t = ds[0]
+    assert noisy.shape == (64, 64, 3) and target.shape == (64, 64, 3)
+    assert 1 <= t <= 6
+    assert noisy.dtype == np.float32
+    assert noisy.min() >= -1.0 and noisy.max() <= 1.0
+    # explicit t: chain mode gives (D(t), D(t-1)) of the same clean image
+    n6, t5, _ = ds.__getitem__(0, t=6)
+    img_direct = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64],
+                                       target_mode="direct").__getitem__(0, t=6)
+    x0 = img_direct[1]
+    np.testing.assert_array_equal(n6, resize.cold_degrade(x0, 64, 64))
+    np.testing.assert_array_equal(t5, resize.cold_degrade(x0, 32, 64))
+
+
+def test_cold_dataset_direct_mode(synthetic_image_dir):
+    ds = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64], target_mode="direct")
+    noisy, target, t = ds.__getitem__(3, t=2)
+    # direct mode target is the clean image itself
+    np.testing.assert_array_equal(target, ds.__getitem__(3, t=5)[1])
+    np.testing.assert_array_equal(noisy, resize.cold_degrade(target, 4, 64))
+
+
+def test_cold_dataset_rejects_nonsquare(synthetic_image_dir):
+    with pytest.raises(ValueError, match="square"):
+        ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 32])
+
+
+def test_diffusion_dataset_contract(synthetic_image_dir):
+    ds = DiffusionDataset(synthetic_image_dir, imgSize=[32, 32], max_step=2000)
+    noisy, img, t = ds[4]
+    assert noisy.shape == (32, 32, 3) and img.shape == (32, 32, 3)
+    assert 0 <= t < 2000
+    # index honored (quirk #2 fixed): different files differ
+    a = ds.__getitem__(0, t=100)[1]
+    b = ds.__getitem__(1, t=100)[1]
+    assert not np.array_equal(a, b)
+    # forward noising at t: noisy = sqrt(a)*img + sqrt(1-a)*eps with finite stats
+    assert np.isfinite(noisy).all()
+
+
+def test_dataset_determinism(synthetic_image_dir):
+    ds = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64], seed=7)
+    a = ds[2]
+    b = ds[2]
+    np.testing.assert_array_equal(a[0], b[0])
+    assert a[2] == b[2]
+    ds.set_epoch(1)  # new epoch → new t draw (almost surely different pair)
+    c = ds[2]
+    assert (a[2] != c[2]) or not np.array_equal(a[0], c[0]) or True  # t may collide; just smoke
+    ds2 = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64], seed=7)
+    d = ds2[2]
+    np.testing.assert_array_equal(a[0], d[0])  # same seed/epoch/index → identical
+
+
+# ---------- sharded loader ----------
+
+class _IntDataset:
+    """Items are (index-array, index-array, index) so batches reveal ordering."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        a = np.full((2, 2, 3), i, dtype=np.float32)
+        return a, a, i
+
+    def __len__(self):
+        return self.n
+
+
+def _collect_indices(loader):
+    out = []
+    for _, _, t in loader:
+        out.extend(int(v) for v in t)
+    return out
+
+
+def test_loader_shards_partition_train():
+    n, world = 103, 4
+    shards = []
+    for r in range(world):
+        ld = ShardedLoader(_IntDataset(n), batch_size=5, shuffle=True, seed=42,
+                           drop_last=True, shard_index=r, shard_count=world,
+                           num_threads=1)
+        ld.set_epoch(0)
+        shards.append(_collect_indices(ld))
+    # equal sizes, disjoint, subset of range(n); drop_last trims to floor(103/4)*4=100
+    sizes = {len(s) for s in shards}
+    assert sizes == {25}
+    flat = [i for s in shards for i in s]
+    assert len(set(flat)) == 100
+    assert set(flat) <= set(range(n))
+
+
+def test_loader_epoch_reshuffle_deterministic():
+    ld = ShardedLoader(_IntDataset(50), batch_size=5, shuffle=True, seed=42,
+                       drop_last=True, num_threads=1)
+    ld.set_epoch(0)
+    e0 = _collect_indices(ld)
+    ld.set_epoch(0)
+    assert _collect_indices(ld) == e0  # deterministic per epoch
+    ld.set_epoch(1)
+    e1 = _collect_indices(ld)
+    assert e0 != e1 and set(e0) == set(e1)  # reshuffled, same coverage
+
+
+def test_loader_eval_padding():
+    n, world = 10, 4  # ceil(10/4)*4 = 12 → 2 wrap-around pads
+    shards = []
+    for r in range(world):
+        ld = ShardedLoader(_IntDataset(n), batch_size=2, shuffle=False,
+                           drop_last=False, shard_index=r, shard_count=world,
+                           num_threads=1)
+        shards.append(_collect_indices(ld))
+    assert all(len(s) == 3 for s in shards)
+    flat = [i for s in shards for i in s]
+    assert set(flat) == set(range(n))  # every item seen at least once
+
+
+def test_loader_pad_final_batch():
+    """Eval batches must all be full size (sharded leading dim needs even
+    divisibility over the 'data' mesh axis)."""
+    ld = ShardedLoader(_IntDataset(10), batch_size=4, shuffle=False,
+                       drop_last=False, pad_final_batch=True, num_threads=1)
+    batches = list(ld)
+    assert len(batches) == 3
+    assert all(b[0].shape[0] == 4 for b in batches)
+    # padding wraps from the start of the shard's index order
+    assert batches[-1][2].tolist() == [8, 9, 0, 1]
+
+
+def test_loader_dataset_smaller_than_shards():
+    """Tiled padding: every shard gets a batch even with 3 items / 8 shards."""
+    counts = []
+    for r in range(8):
+        ld = ShardedLoader(_IntDataset(3), batch_size=1, shuffle=False,
+                           drop_last=False, shard_index=r, shard_count=8,
+                           num_threads=1)
+        counts.append(sum(1 for _ in ld))
+    assert counts == [1] * 8  # equal batch counts → no multi-host deadlock
+
+
+def test_loader_abandoned_iterator_stops_decoding():
+    """Breaking out of iteration must not keep decoding the whole epoch."""
+    import time
+
+    decoded = []
+
+    class SlowDs:
+        def __getitem__(self, i):
+            decoded.append(i)
+            return np.zeros((2, 2, 3), np.float32), np.zeros((2, 2, 3), np.float32), i
+
+        def __len__(self):
+            return 10_000
+
+    ld = ShardedLoader(SlowDs(), batch_size=10, shuffle=False, drop_last=True,
+                       num_threads=4, prefetch=1)
+    it = iter(ld)
+    next(it)
+    it.close()  # abandon
+    time.sleep(0.3)
+    n = len(decoded)
+    time.sleep(0.3)
+    # decoding stopped (allow the in-flight batch to finish)
+    assert len(decoded) - n <= ld.batch_size
+    assert len(decoded) < 200
+
+
+def test_loader_threaded_matches_sync(synthetic_image_dir):
+    ds = ColdDownSampleDataset(synthetic_image_dir, imgSize=[64, 64])
+    a = list(ShardedLoader(ds, batch_size=4, shuffle=True, seed=1, num_threads=1))
+    b = list(ShardedLoader(ds, batch_size=4, shuffle=True, seed=1, num_threads=4))
+    assert len(a) == len(b) == 2
+    for (x1, y1, t1), (x2, y2, t2) in zip(a, b):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(t1, t2)
