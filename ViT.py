@@ -73,9 +73,10 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
 
     img = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed + 1),
                                k=acc_k, n=sample_n)
-    side = max(int(sample_n ** 0.5), 1)
+    ncols = max(int(sample_n ** 0.5), 1)
+    nrows = -(-sample_n // ncols)  # ceil: show every generated sample
     out = save_grid(img, get_next_path(os.path.join(saved, "samples.png")),
-                    nrows=side, ncols=side)
+                    nrows=nrows, ncols=ncols)
     print(f"wrote {out}")
 
 

@@ -19,6 +19,7 @@ loss (multi_gpu_trainer.py:53-55,94-106,126,135-163).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from dataclasses import dataclass
@@ -81,6 +82,9 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     if ndev > len(avail):
         print_log(f"requested {ndev} devices, only {len(avail)} visible — clamping", log)
         ndev = len(avail)
+        # keep the lr↔global-batch linear-scaling rule consistent with the
+        # batch actually trained (config.lr derives from num_devices)
+        config = dataclasses.replace(config, num_devices=ndev)
     mesh_shape = config.mesh or {"data": ndev}
     mesh = make_mesh(mesh_shape, devices=avail[: int(np.prod(list(mesh_shape.values())))])
 

@@ -151,7 +151,14 @@ def save_torch_pkl(params, path: str, patch_size: int) -> None:
 # ---------------------------------------------------------------------------
 
 def _to_host(tree):
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    def conv(x):
+        # multi-host shards aren't host-materializable; orbax writes global
+        # jax.Arrays distributedly, so pass them through untouched.
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            return x
+        return np.asarray(x)
+
+    return jax.tree.map(conv, tree)
 
 
 def save_checkpoint(path: str, tree) -> None:

@@ -1,0 +1,39 @@
+"""Tracing/profiling + numeric-debug hooks (SURVEY.md §5 aux subsystems).
+
+The reference has no profiler — only wall-clock prints (per-100-step
+``time_cost`` and per-sampler-step elapsed, multi_gpu_trainer.py:135-138,
+ViT.py:222-235). Here the equivalents are structural:
+
+* ``trace(dir)`` — a ``jax.profiler`` trace context; view in TensorBoard or
+  Perfetto. Wrap any train/sample region.
+* ``annotate(name)`` — named TraceAnnotation so steps show up labeled.
+* ``enable_nan_checks()`` — ``jax_debug_nans`` (the SPMD replacement for the
+  reference's commented TORCH_DISTRIBUTED_DEBUG, with actually-useful
+  semantics: fail at the op that produced the NaN).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows up on the TPU timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Re-run suspect computations de-optimized and raise at NaN origin."""
+    jax.config.update("jax_debug_nans", enable)
