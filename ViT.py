@@ -38,7 +38,7 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
     from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
     from ddim_cold_tpu.ops import sampling
     from ddim_cold_tpu.utils import checkpoint as ckpt
-    from ddim_cold_tpu.utils.image import get_next_path, save_grid
+    from ddim_cold_tpu.utils.image import get_next_path, grid_shape, save_grid
 
     model = DiffusionViT(total_steps=2000, **MODEL_CONFIGS[config_name])
     saved = os.path.join(HERE, "Saved_Models")
@@ -73,8 +73,7 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
 
     img = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed + 1),
                                k=acc_k, n=sample_n)
-    ncols = max(int(sample_n ** 0.5), 1)
-    nrows = -(-sample_n // ncols)  # ceil: show every generated sample
+    nrows, ncols = grid_shape(sample_n)
     out = save_grid(img, get_next_path(os.path.join(saved, "samples.png")),
                     nrows=nrows, ncols=ncols)
     print(f"wrote {out}")

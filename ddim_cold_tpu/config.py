@@ -62,9 +62,21 @@ class ExperimentConfig:
         return self.batch_size * 2 if self.amp else self.batch_size
 
     @property
+    def data_parallel_size(self) -> int:
+        """Devices the batch is split over: mesh['data'] when an explicit mesh
+        is configured, else num_devices (the pure-dp default)."""
+        if self.mesh:
+            return int(self.mesh.get("data", 1))
+        return self.num_devices
+
+    @property
     def lr(self) -> float:
-        """base_lr · batch · devices / 512 (multi_gpu_trainer.py:196)."""
-        return self.base_lr * self.effective_batch * self.num_devices / 512.0
+        """base_lr · batch · dp-world / 512 (multi_gpu_trainer.py:196).
+
+        The reference's ``num_gpus`` IS its dp world size; with an explicit
+        mesh the dp world is mesh['data'], keeping lr tied to the global batch
+        actually trained."""
+        return self.base_lr * self.effective_batch * self.data_parallel_size / 512.0
 
     @property
     def total_steps(self) -> int:

@@ -127,6 +127,56 @@ def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10) -> 
     return ddim_sample(model, params, x_init=x_init, t_start=t_start, k=k)
 
 
+def slerp(a: jax.Array, b: jax.Array, frac: jax.Array) -> jax.Array:
+    """Spherical interpolation between two (batches of) latents.
+
+    The primitive of the reference's dormant interpolation app
+    (ViT_draft2drawing.py:422-476): mix two forward-noised encodings on the
+    great circle, then DDIM-decode with ``sample_from``. ``frac`` broadcasts
+    against the leading axes, so a (F, 1, 1, 1, 1) fraction vector against
+    (N, H, W, C) endpoints yields all F interpolants in one shot.
+    """
+    flat_a = a.reshape(a.shape[0], -1) if a.ndim > 1 else a[None]
+    flat_b = b.reshape(b.shape[0], -1) if b.ndim > 1 else b[None]
+    cos = jnp.sum(flat_a * flat_b, -1) / (
+        jnp.linalg.norm(flat_a, axis=-1) * jnp.linalg.norm(flat_b, axis=-1)
+    )
+    theta_shape = (a.shape[:1] + (1,) * (a.ndim - 1)) if a.ndim > 1 else ()
+    theta = jnp.arccos(jnp.clip(cos, -1.0, 1.0)).reshape(theta_shape)
+    sin = jnp.sin(theta)
+    # guard the denominator so the untaken branch carries no NaN (0/0) —
+    # keeps jax_debug_nans and grads clean near parallel endpoints.
+    safe_sin = jnp.where(sin < 1e-6, 1.0, sin)
+    wa = jnp.sin((1.0 - frac) * theta) / safe_sin
+    wb = jnp.sin(frac * theta) / safe_sin
+    # degenerate (parallel) endpoints: fall back to lerp
+    lin = (1.0 - frac) * a + frac * b
+    return jnp.where(sin < 1e-6, lin, wa * a + wb * b)
+
+
+def slerp_interpolate(
+    model,
+    params,
+    rng: jax.Array,
+    img_a: jax.Array,
+    img_b: jax.Array,
+    *,
+    n_interp: int = 8,
+    t_start: int = 1800,
+    k: int = 10,
+) -> jax.Array:
+    """End-to-end latent interpolation (C25): encode both images to ``t_start``
+    (one rng key, independent noise per endpoint — matching the reference's two
+    separate draws, ViT_draft2drawing.py:442-443), slerp ``n_interp`` fractions
+    between the encodings, and DDIM-decode each — returns (n_interp, H, W, C)
+    in [0, 1]."""
+    batch = jnp.stack([img_a, img_b])
+    noisy = forward_noise(rng, batch, t_start, model.total_steps)
+    frac = jnp.linspace(0.0, 1.0, n_interp).reshape(-1, 1, 1, 1, 1)
+    mixed = slerp(noisy[0][None], noisy[1][None], frac)[:, 0]
+    return sample_from(model, params, mixed, t_start=t_start, k=k)
+
+
 @partial(jax.jit, static_argnames=("model", "levels", "return_sequence"))
 def _cold_scan(model, params, x_init, *, levels: int, return_sequence: bool):
     t_seq = jnp.asarray(schedule.cold_time_sequence(levels))

@@ -57,8 +57,7 @@ def ring_attention(
     m = vary(jnp.full((B, H, n_loc), _NEG_INF, jnp.float32))
     qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B,H,nq,D)
 
-    def body(_, carry):
-        o, l, m, k_blk, v_blk, valid_blk = carry
+    def accumulate(o, l, m, k_blk, v_blk, valid_blk):
         logits = jnp.einsum("bhqd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
         logits = jnp.where(valid_blk[:, None, None, :], logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -67,13 +66,22 @@ def ring_attention(
         l = l * corr + p.sum(axis=-1)
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return o, l, m_new
+
+    def body(_, carry):
+        o, l, m, k_blk, v_blk, valid_blk = carry
+        o, l, m = accumulate(o, l, m, k_blk, v_blk, valid_blk)
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         valid_blk = jax.lax.ppermute(valid_blk, axis_name, perm)
-        return o, l, m_new, k_blk, v_blk, valid_blk
+        return o, l, m, k_blk, v_blk, valid_blk
 
-    o, l, _, _, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v, kv_valid))
+    # axis_size − 1 rotations; the final block is consumed outside the loop so
+    # no dead last exchange rides the ICI.
+    o, l, m, k_blk, v_blk, valid_blk = jax.lax.fori_loop(
+        0, axis_size - 1, body, (o, l, m, k, v, kv_valid))
+    o, l, _ = accumulate(o, l, m, k_blk, v_blk, valid_blk)
     out = o / l[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

@@ -51,6 +51,14 @@ class TrainResult:
     run_dir: str
 
 
+def _fully_addressable(tree) -> bool:
+    """True when every array shard lives on this host (single-host runs) —
+    the precondition for materializing params into a torch-style pickle."""
+    return all(
+        getattr(x, "is_fully_addressable", True) for x in jax.tree.leaves(tree)
+    )
+
+
 def _build_dataset(config: ExperimentConfig, root: str):
     if config.dataset == "cold":
         return ColdDownSampleDataset(root, imgSize=config.image_size, target_mode="chain")
@@ -78,14 +86,25 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
 
     # -- mesh over the requested device count ------------------------------
     avail = jax.devices()
-    ndev = config.num_devices
-    if ndev > len(avail):
-        print_log(f"requested {ndev} devices, only {len(avail)} visible — clamping", log)
-        ndev = len(avail)
-        # keep the lr↔global-batch linear-scaling rule consistent with the
-        # batch actually trained (config.lr derives from num_devices)
-        config = dataclasses.replace(config, num_devices=ndev)
-    mesh_shape = config.mesh or {"data": ndev}
+    if config.mesh:
+        # explicit mesh: the global batch and lr both derive from mesh['data']
+        # (config.data_parallel_size), so clamping num_devices would change
+        # nothing but the lr — a too-small host is a hard error instead.
+        mesh_shape = dict(config.mesh)
+        need = int(np.prod(list(mesh_shape.values())))
+        if need > len(avail):
+            raise ValueError(
+                f"config.mesh {mesh_shape} needs {need} devices, "
+                f"only {len(avail)} visible")
+    else:
+        ndev = config.num_devices
+        if ndev > len(avail):
+            print_log(f"requested {ndev} devices, only {len(avail)} visible — clamping", log)
+            ndev = len(avail)
+            # keep the lr↔global-batch linear-scaling rule consistent with the
+            # batch actually trained (config.lr derives from num_devices here)
+            config = dataclasses.replace(config, num_devices=ndev)
+        mesh_shape = {"data": ndev}
     mesh = make_mesh(mesh_shape, devices=avail[: int(np.prod(list(mesh_shape.values())))])
 
     # -- data --------------------------------------------------------------
@@ -200,21 +219,26 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         if jax.process_index() == 0:
             print_log(f"epoch: {epoch:4d}    loss: {vloss:.5f}    time:{asctime()}", log)
             writer.add_scalar("loss", vloss, epoch)
-            if vloss < best_loss:
-                best_loss = vloss
-                ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), state.params)
+        # orbax writes of sharded global arrays are collective — EVERY process
+        # calls save_checkpoint (vloss is a global mean, identical on all
+        # hosts, so the branch agrees); only logging and the host-local torch
+        # pkl export stay process-0-gated.
+        if vloss < best_loss:
+            best_loss = vloss
+            ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), state.params)
+            if jax.process_index() == 0 and _fully_addressable(state.params):
                 try:
                     ckpt.save_torch_pkl(state.params,
                                         os.path.join(run_dir, "bestloss.pkl"),
                                         config.patch_size)
                 except ImportError:
                     pass
-            ckpt.save_checkpoint(
-                os.path.join(run_dir, "lastepoch.ckpt"),
-                {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
-                 "metric": best_loss, "params": state.params,
-                 "opt_state": state.opt_state},
-            )
+        ckpt.save_checkpoint(
+            os.path.join(run_dir, "lastepoch.ckpt"),
+            {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
+             "metric": best_loss, "params": state.params,
+             "opt_state": state.opt_state},
+        )
         if done:
             break
     writer.close()

@@ -34,6 +34,13 @@ def save_grid(images: np.ndarray, path: str, *, nrows: int, ncols: int, pad: int
     return path
 
 
+def grid_shape(n: int) -> tuple[int, int]:
+    """(nrows, ncols) for tiling n images: ⌊√n⌋ columns, rows ceil-divided so
+    every sample is shown (the reference's 16×16 grid generalized)."""
+    ncols = max(int(n**0.5), 1)
+    return -(-n // ncols), ncols
+
+
 def get_next_path(pth: str) -> str:
     """First non-existing ``<stem>_<i><ext>`` (reference intent, loop fixed)."""
     prefix, ext = os.path.splitext(pth)

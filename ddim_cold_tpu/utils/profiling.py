@@ -14,19 +14,14 @@ ViT.py:222-235). Here the equivalents are structural:
 
 from __future__ import annotations
 
-import contextlib
-
 import jax
 
 
-@contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture a device trace into ``log_dir``."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    """Capture a device trace into ``log_dir`` — ``jax.profiler.trace`` is
+    already a context manager with stop-in-finally semantics; pass through so
+    upstream improvements (perfetto links, etc.) come for free."""
+    return jax.profiler.trace(log_dir)
 
 
 def annotate(name: str):

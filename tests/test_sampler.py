@@ -122,3 +122,69 @@ def test_ddim_sample_requires_rng_or_init(model_and_params):
     model, params = model_and_params
     with pytest.raises(ValueError, match="rng or x_init"):
         sampling.ddim_sample(model, params, k=100)
+
+
+def test_slerp_endpoints_and_midpoint():
+    """frac=0/1 return the endpoints; the midpoint of two orthogonal unit
+    vectors is the normalized bisector (classic slerp identity)."""
+    a = jnp.asarray([[1.0, 0.0]])
+    b = jnp.asarray([[0.0, 1.0]])
+    np.testing.assert_allclose(np.asarray(sampling.slerp(a, b, 0.0)), np.asarray(a), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sampling.slerp(a, b, 1.0)), np.asarray(b), atol=1e-6)
+    mid = np.asarray(sampling.slerp(a, b, 0.5))
+    np.testing.assert_allclose(mid, [[math.sqrt(0.5), math.sqrt(0.5)]], rtol=1e-6)
+
+
+def test_slerp_parallel_fallback():
+    """Parallel endpoints degenerate to lerp instead of 0/0."""
+    a = jnp.ones((1, 8))
+    out = np.asarray(sampling.slerp(a, a * 1.0, 0.3))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, np.ones((1, 8)), rtol=1e-5)
+
+
+def test_slerp_preserves_norm_on_sphere():
+    """Interpolating unit vectors stays on the unit sphere for every frac."""
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 32)
+    b = rs.randn(4, 32)
+    a /= np.linalg.norm(a, axis=-1, keepdims=True)
+    b /= np.linalg.norm(b, axis=-1, keepdims=True)
+    for frac in (0.25, 0.5, 0.75):
+        out = np.asarray(sampling.slerp(jnp.asarray(a), jnp.asarray(b), frac))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=-1), 1.0, rtol=1e-5)
+
+
+def test_slerp_interpolate_end_to_end(model_and_params):
+    """C25: endpoints of the interpolation equal sample_from of each encoding."""
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(7)
+    img_a = jnp.clip(jax.random.normal(jax.random.PRNGKey(8), (16, 16, 3)), -1, 1)
+    img_b = jnp.clip(jax.random.normal(jax.random.PRNGKey(9), (16, 16, 3)), -1, 1)
+    frames = sampling.slerp_interpolate(model, params, rng, img_a, img_b,
+                                        n_interp=3, t_start=1500, k=500)
+    assert frames.shape == (3, 16, 16, 3)
+    a = np.asarray(frames)
+    assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+    # frac=0 endpoint ≡ decode of img_a's encoding (same rng key → same eps batch)
+    noisy = sampling.forward_noise(rng, jnp.stack([img_a, img_b]), 1500, T)
+    want = sampling.sample_from(model, params, noisy[:1], t_start=1500, k=500)
+    np.testing.assert_allclose(a[0], np.asarray(want[0]), rtol=1e-4, atol=1e-5)
+
+
+def test_slerp_unbatched_1d_vectors():
+    """The 1-D (unbatched) path interpolates instead of crashing."""
+    a = jnp.asarray([1.0, 0.0])
+    b = jnp.asarray([0.0, 1.0])
+    mid = np.asarray(sampling.slerp(a, b, 0.5))
+    np.testing.assert_allclose(mid, [math.sqrt(0.5), math.sqrt(0.5)], rtol=1e-6)
+
+
+def test_slerp_no_nan_under_debug_nans():
+    """Parallel endpoints produce no NaN intermediates (jax_debug_nans-safe)."""
+    jax.config.update("jax_debug_nans", True)
+    try:
+        out = sampling.slerp(jnp.ones((2, 8)), jnp.ones((2, 8)), 0.4)
+        assert np.isfinite(np.asarray(out)).all()
+    finally:
+        jax.config.update("jax_debug_nans", False)
