@@ -21,7 +21,9 @@ but never forwards it, multi_gpu_trainer.py:206 vs ViT.py:162).
 New optional keys (defaulted so reference YAMLs run unchanged):
 ``dataset`` (cold | cold_direct | gaussian — the trainer hardwires cold,
 multi_gpu_trainer.py:5,59), ``seed``, ``honor_diff_step``, ``mesh`` (axis
-sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``).
+sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``), ``use_flash``
+(Pallas fused attention, recommended for the 200px configs) and
+``use_sincos_pos`` (fixed sinusoidal positional table, C7).
 """
 
 from __future__ import annotations
@@ -55,6 +57,8 @@ class ExperimentConfig:
     seed: int = 42
     honor_diff_step: bool = False
     mesh: Optional[dict[str, int]] = None
+    use_flash: bool = False
+    use_sincos_pos: bool = False
 
     @property
     def effective_batch(self) -> int:
@@ -96,6 +100,8 @@ class ExperimentConfig:
             depth=self.depth,
             num_heads=self.head,
             total_steps=self.total_steps,
+            use_flash=self.use_flash,
+            use_sincos_pos=self.use_sincos_pos,
         )
 
 
@@ -126,4 +132,6 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         seed=int(raw.get("seed", 42)),
         honor_diff_step=bool(raw.get("honor_diff_step", False)),
         mesh=raw.get("mesh"),
+        use_flash=bool(raw.get("use_flash", False)),
+        use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
     )

@@ -104,10 +104,13 @@ class Attention(nn.Module):
     """Multi-head self-attention, fused-QKV (reference ViT.py:93-117).
 
     Returns ``(x, attn)`` like the reference so the attention-probe path
-    (Block.return_attention) stays expressible. Softmax runs in float32
-    regardless of compute dtype. The einsum layout keeps the two contractions
-    as plain batched GEMMs for the MXU and is the slot-in point for the Pallas
-    flash-attention kernel used by long-sequence configs.
+    (Block.return_attention) stays expressible — EXCEPT when the Pallas
+    fused kernel runs (``use_flash`` on, ``need_weights=False``, attention
+    dropout inactive), which never materializes the weights and returns
+    ``(x, None)``. Callers that need the weights must pass
+    ``need_weights=True`` (Block does this for its probe path). Softmax runs
+    in float32 regardless of compute dtype; the einsum layout keeps the two
+    contractions as plain batched GEMMs for the MXU.
     """
 
     dim: int
@@ -117,9 +120,11 @@ class Attention(nn.Module):
     attn_drop: float = 0.0
     proj_drop: float = 0.0
     dtype: Dtype = jnp.float32
+    use_flash: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True):
+    def __call__(self, x: jax.Array, deterministic: bool = True,
+                 need_weights: bool = True):
         B, N, C = x.shape
         head_dim = C // self.num_heads
         scale = self.qk_scale or head_dim**-0.5
@@ -137,10 +142,24 @@ class Attention(nn.Module):
         qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, N, H, hd)
 
-        logits = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
-        attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
-        attn = nn.Dropout(self.attn_drop, deterministic=deterministic)(attn)
-        out = jnp.einsum("bhnm,bmhd->bnhd", attn, v)
+        # Pallas fused path: no O(N²) HBM attention matrix. Requires inactive
+        # attention-dropout (the kernel never materializes the weights — with
+        # dropout on, fall back to the einsum path) and no weight probing.
+        flash_ok = (
+            self.use_flash
+            and not need_weights
+            and (deterministic or self.attn_drop == 0.0)
+        )
+        if flash_ok:
+            from ddim_cold_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, scale).astype(self.dtype)
+            attn = None
+        else:
+            logits = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+            attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+            attn = nn.Dropout(self.attn_drop, deterministic=deterministic)(attn)
+            out = jnp.einsum("bhnm,bmhd->bnhd", attn, v)
 
         out = out.reshape(B, N, C)
         out = nn.Dense(
@@ -166,6 +185,7 @@ class Block(nn.Module):
     attn_drop: float = 0.0
     drop_path: float = 0.0
     dtype: Dtype = jnp.float32
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, return_attention: bool = False):
@@ -178,8 +198,10 @@ class Block(nn.Module):
             attn_drop=self.attn_drop,
             proj_drop=self.drop,
             dtype=self.dtype,
+            use_flash=self.use_flash,
             name="attn",
-        )(ln("norm1")(x), deterministic=deterministic)
+        )(ln("norm1")(x), deterministic=deterministic,
+          need_weights=return_attention)
         if return_attention:
             return attn
 
@@ -264,6 +286,7 @@ class DiffusionViT(nn.Module):
     total_steps: int = 2000
     dtype: Dtype = jnp.float32
     use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
+    use_flash: bool = False  # Pallas fused attention (long-seq configs)
 
     @property
     def num_patches(self) -> int:
@@ -325,6 +348,7 @@ class DiffusionViT(nn.Module):
                 attn_drop=self.attn_drop_rate,
                 drop_path=float(dpr[i]),
                 dtype=self.dtype,
+                use_flash=self.use_flash,
                 name=f"blocks_{i}",
             )
             if return_attention_layer is not None and i == return_attention_layer % self.depth:

@@ -1,0 +1,146 @@
+"""Fused softmax-attention Pallas kernel for TPU (the long-sequence hot op).
+
+The reference's attention is three separate cuDNN GEMMs with an O(N²) f32
+attention matrix materialized in HBM (ViT.py:110-114). Here the whole
+``softmax(q·kᵀ·scale)·v`` is one Pallas kernel: a grid over (batch·heads,
+query blocks) where each program streams its K/V through VMEM, so the logits
+never round-trip to HBM. For the in-repo configs (N ≤ 2501: the 200px/p4
+model) K/V for one head fit VMEM whole, giving a single-pass masked softmax
+per query block — the MXU sees two back-to-back GEMMs.
+
+Autodiff: forward is the kernel; backward is a custom VJP that recomputes the
+attention matrix with plain XLA einsums (flash-style recompute — O(N²) HBM
+only under ``grad``, which the training path only hits with dropout disabled;
+with attention dropout active the model falls back to the einsum path anyway).
+
+On non-TPU backends the kernel runs in interpreter mode, so tests exercise the
+identical code path on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU-only hosts, but guard for odd builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30
+_LANE = 128  # TPU lane width: last dim of VMEM tiles
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, n_valid: int):
+    """One (head, query-block) program: out = softmax(mask(q·kᵀ))·v in f32."""
+    q = q_ref[0].astype(jnp.float32)  # (bq, D)
+    k = k_ref[0].astype(jnp.float32)  # (N, D)
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, N)
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < n_valid, logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    out = jnp.dot(p, v_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32)
+    o_ref[0] = (out / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: float,
+    block_q: int = 256,
+) -> jax.Array:
+    """Fused non-causal multi-head attention.
+
+    q/k/v: ``(B, N, H, D)`` (the model's head layout, ViT.py:104-107);
+    returns ``(B, N, H, D)`` in q's dtype. Softmax runs in float32 regardless
+    of input dtype, matching the einsum path bit-for-bit up to GEMM precision.
+    """
+    return _flash_forward(q, k, v, scale, block_q)
+
+
+def _flash_forward(q, k, v, scale, block_q):
+    # Interpreter mode exists so CPU tests exercise the kernel path; on any
+    # other non-TPU backend (e.g. GPU) interpreting would be a silent
+    # orders-of-magnitude slowdown — use the dense einsum instead.
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        return _dense_attention_f32(q, k, v, scale)[1].astype(q.dtype)
+
+    B, N, H, D = q.shape
+    # (B, N, H, D) → (B·H, N, D): each grid row owns one head's sequence.
+    def to_heads(x):
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, N, D)
+        # lane-align the head dim (zero columns are inert in q·kᵀ and produce
+        # zero output columns, sliced off below) and sublane-align N.
+        x = _pad_to(x, 2, _LANE)
+        return _pad_to(x, 1, 8)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    BH, Np, Dp = qh.shape
+    bq = min(block_q, Np)
+    qh = _pad_to(qh, 1, bq)
+    grid = (BH, qh.shape[1] // bq)
+
+    kernel = functools.partial(_attention_kernel, scale=scale, n_valid=N)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Np, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Np, Dp), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=backend == "cpu",
+    )(qh, kh, vh)
+
+    out = out[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)
+    return out
+
+
+def _dense_attention_f32(q, k, v, scale):
+    """XLA-einsum oracle/backward path, f32 accumulation (ViT.py:110-114)."""
+    logits = jnp.einsum(
+        "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    p = jax.nn.softmax(logits, axis=-1)
+    return p, jnp.einsum("bhnm,bmhd->bnhd", p, v.astype(jnp.float32))
+
+
+def _flash_fwd(q, k, v, scale, block_q):
+    return _flash_forward(q, k, v, scale, block_q), (q, k, v)
+
+
+def _flash_bwd(scale, block_q, residuals, g):
+    q, k, v = residuals
+    p, _ = _dense_attention_f32(q, k, v, scale)  # recompute (flash-style)
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhnm,bnhd->bmhd", p, gf)
+    dp = jnp.einsum("bnhd,bmhd->bhnm", gf, v.astype(jnp.float32))
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhnm,bmhd->bnhd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhnm,bnhd->bmhd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,92 @@
+"""Flash-attention kernel parity: the Pallas fused path must match the dense
+einsum path (the reference semantics, ViT.py:110-114) on both odd and aligned
+sequence lengths, under grad, and when slotted into the full model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.models import DiffusionViT
+from ddim_cold_tpu.ops.flash_attention import _dense_attention_f32, flash_attention
+
+
+def _rand_qkv(rng, B, N, H, D):
+    ks = jax.random.split(jax.random.PRNGKey(rng), 3)
+    return tuple(jax.random.normal(k, (B, N, H, D), jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("N", [8, 257, 320])
+def test_flash_matches_dense(N):
+    """257 = the OxfordFlower-64 sequence (odd, needs padding); 320 aligned."""
+    q, k, v = _rand_qkv(0, 2, N, 4, 16)
+    scale = 16**-0.5
+    ours = np.asarray(flash_attention(q, k, v, scale))
+    _, want = _dense_attention_f32(q, k, v, scale)
+    np.testing.assert_allclose(ours, np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_flash_block_q_smaller_than_seq():
+    """Multiple query blocks per head (block_q < N) tile correctly."""
+    q, k, v = _rand_qkv(1, 1, 100, 2, 8)
+    ours = np.asarray(flash_attention(q, k, v, 8**-0.5, 32))
+    _, want = _dense_attention_f32(q, k, v, 8**-0.5)
+    np.testing.assert_allclose(ours, np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_flash_bf16_inputs():
+    q, k, v = _rand_qkv(2, 1, 64, 2, 8)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, 8**-0.5)
+    assert out.dtype == jnp.bfloat16
+    _, want = _dense_attention_f32(qb, kb, vb, 8**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(want, np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_flash_gradient_matches_dense():
+    """Custom VJP (recompute backward) ≡ autodiff through the einsum path."""
+    q, k, v = _rand_qkv(3, 1, 33, 2, 8)
+    scale = 8**-0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, scale) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_attention_f32(q, k, v, scale)[1] ** 2)
+
+    g_ours = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for ours, want in zip(g_ours, g_want):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_model_use_flash_parity():
+    """DiffusionViT(use_flash=True) ≡ the einsum model in eval mode — same
+    params tree (flash adds no parameters), same outputs."""
+    cfg = dict(img_size=(16, 16), patch_size=4, embed_dim=32, depth=2, num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    t = jnp.array([3, 500], jnp.int32)
+    base = DiffusionViT(**cfg)
+    params = base.init(jax.random.PRNGKey(1), x, t)["params"]
+    flash = DiffusionViT(use_flash=True, **cfg)
+    out_base = base.apply({"params": params}, x, t)
+    out_flash = flash.apply({"params": params}, x, t)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_base),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_model_attention_probe_still_works_with_flash():
+    """return_attention_layer forces the weights-producing path even when
+    use_flash is on (the kernel never materializes attention weights)."""
+    cfg = dict(img_size=(16, 16), patch_size=4, embed_dim=32, depth=2, num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    t = jnp.zeros((1,), jnp.int32)
+    model = DiffusionViT(use_flash=True, **cfg)
+    params = model.init(jax.random.PRNGKey(1), x, t)["params"]
+    attn = model.apply({"params": params}, x, t, return_attention_layer=1)
+    assert attn.shape == (1, 4, 17, 17)
+    s = np.asarray(jnp.sum(attn, axis=-1))
+    np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
