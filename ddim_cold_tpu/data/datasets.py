@@ -27,7 +27,7 @@ from typing import Optional, Sequence
 import numpy as np
 from PIL import Image
 
-from ddim_cold_tpu.data import resize
+from ddim_cold_tpu.data import native, resize
 
 _IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
 
@@ -48,11 +48,20 @@ def _list_images(root: str) -> list[str]:
     return names
 
 
-def _load_base(path: str, img_size: Sequence[int]) -> np.ndarray:
+def _load_base(path: str, img_size: Sequence[int], use_native: bool = True) -> np.ndarray:
     """jpg → float32 HWC in [−1, 1]: to_tensor (÷255) → bilinear resize →
-    ·2−1 (reference diffusion_loader.py:47-49 order)."""
+    ·2−1 (reference diffusion_loader.py:47-49 order).
+
+    Dispatches to the native C++ decoder (data/native.py) when available —
+    same math, same output, no GIL; falls back to PIL/numpy per-file.
+    """
+    hw = (int(img_size[0]), int(img_size[1]))
+    if use_native:
+        out = native.load_base(path, hw)
+        if out is not None:
+            return out
     img = np.asarray(pil_loader(path), dtype=np.float32) / 255.0
-    img = resize.resize_bilinear(img, (int(img_size[0]), int(img_size[1])))
+    img = resize.resize_bilinear(img, hw)
     return img * 2.0 - 1.0
 
 
@@ -64,11 +73,12 @@ class DiffusionDataset:
     """
 
     def __init__(self, root: str, imgSize: Sequence[int] = (32, 32), max_step: int = 2000,
-                 seed: int = 0):
+                 seed: int = 0, use_native: bool = True):
         self.root = root
         self.img_size = tuple(int(s) for s in imgSize)
         self.max_step = max_step
         self.seed = seed
+        self.use_native = use_native
         self.epoch = 0
         self.imgList = _list_images(root)
 
@@ -80,15 +90,44 @@ class DiffusionDataset:
             np.random.Philox(np.random.SeedSequence([self.seed, self.epoch, index, 0xD1FF]))
         )
 
-    def __getitem__(self, index: int, t: Optional[int] = None):
-        img = _load_base(os.path.join(self.root, self.imgList[index]), self.img_size)
+    def _noise_for(self, index: int, img: np.ndarray, t: Optional[int]):
+        """(t, x_t) from the per-(seed, epoch, index) Philox stream — t is
+        drawn BEFORE the noise, so native/PIL decode paths see identical
+        randomness."""
         rng = self._rng(index)
+        drawn = int(rng.integers(self.max_step))
         if t is None:
-            t = int(rng.integers(self.max_step))
+            t = drawn
         alpha = 1.0 - math.sqrt((t + 1) / self.max_step)
         noise = rng.standard_normal(img.shape).astype(np.float32)
         noisy = math.sqrt(alpha) * img + math.sqrt(1.0 - alpha) * noise
-        return noisy.astype(np.float32), img.astype(np.float32), t
+        return t, noisy.astype(np.float32)
+
+    def __getitem__(self, index: int, t: Optional[int] = None):
+        img = _load_base(os.path.join(self.root, self.imgList[index]),
+                         self.img_size, self.use_native)
+        t, noisy = self._noise_for(index, img, t)
+        return noisy, img.astype(np.float32), t
+
+    def get_batch(self, indices: Sequence[int], num_threads: int = 8):
+        """Batch fast path: decode+resize in C++ threads, noise in numpy.
+        Returns collated ``(noisy, target, t)`` arrays, or None to make the
+        loader fall back to per-item assembly."""
+        if not self.use_native:
+            return None
+        paths = [os.path.join(self.root, self.imgList[int(i)]) for i in indices]
+        res = native.base_batch(paths, self.img_size, num_threads=num_threads)
+        if res is None:
+            return None
+        base, failed = res
+        for j, i in enumerate(indices):
+            if failed[j]:
+                base[j] = _load_base(paths[j], self.img_size, use_native=False)
+        noisy = np.empty_like(base)
+        ts = np.empty(len(base), np.int32)
+        for j, i in enumerate(indices):
+            ts[j], noisy[j] = self._noise_for(int(i), base[j], None)
+        return noisy, base, ts
 
     def __len__(self) -> int:
         return len(self.imgList)
@@ -109,7 +148,7 @@ class ColdDownSampleDataset:
     """
 
     def __init__(self, root: str, imgSize: Sequence[int] = (32, 32),
-                 target_mode: str = "chain", seed: int = 0):
+                 target_mode: str = "chain", seed: int = 0, use_native: bool = True):
         if imgSize[0] != imgSize[1]:
             raise ValueError("downsample dataset requires square images")
         if target_mode not in ("chain", "direct"):
@@ -120,6 +159,7 @@ class ColdDownSampleDataset:
         self.max_step = int(np.log2(self.size))
         self.target_mode = target_mode
         self.seed = seed
+        self.use_native = use_native
         self.epoch = 0
         self.imgList = _list_images(root)
 
@@ -130,18 +170,46 @@ class ColdDownSampleDataset:
         """D(x, s) for s = 2^t (reference diffusion_loader.py:79-83)."""
         return resize.cold_degrade(img, level_scale, self.size)
 
+    def _draw_t(self, index: int) -> int:
+        rng = np.random.Generator(
+            np.random.Philox(np.random.SeedSequence([self.seed, self.epoch, index, 0xC01D]))
+        )
+        return int(rng.integers(self.max_step)) + 1  # t ∈ [1, max_step]
+
     def __getitem__(self, index: int, t: Optional[int] = None):
-        img = _load_base(os.path.join(self.root, self.imgList[index]), self.img_size)
+        path = os.path.join(self.root, self.imgList[index])
         if t is None:
-            rng = np.random.Generator(
-                np.random.Philox(np.random.SeedSequence([self.seed, self.epoch, index, 0xC01D]))
-            )
-            t = int(rng.integers(self.max_step)) + 1  # t ∈ [1, max_step]
+            t = self._draw_t(index)
+        if self.use_native:
+            # full item (decode → resize → degrade) in one C++ call
+            res = native.cold_item(path, self.size, t, self.target_mode == "chain")
+            if res is not None:
+                return res[0], res[1], t
+        return self._pil_item(index, t)
+
+    def get_batch(self, indices: Sequence[int], num_threads: int = 8):
+        """Batch fast path: the whole (decode, resize, degrade, collate)
+        pipeline in C++ threads; failed slots redone via PIL with the same t.
+        Returns ``(noisy, target, t)`` or None (→ loader per-item path)."""
+        if not self.use_native:
+            return None
+        paths = [os.path.join(self.root, self.imgList[int(i)]) for i in indices]
+        ts = [self._draw_t(int(i)) for i in indices]
+        res = native.cold_batch(paths, ts, self.size, self.target_mode == "chain",
+                                num_threads=num_threads)
+        if res is None:
+            return None
+        noisy, target, failed = res
+        for j, i in enumerate(indices):
+            if failed[j]:
+                noisy[j], target[j], _ = self._pil_item(int(i), ts[j])
+        return noisy, target, np.asarray(ts, np.int32)
+
+    def _pil_item(self, index: int, t: int):
+        img = _load_base(os.path.join(self.root, self.imgList[index]),
+                         self.img_size, use_native=False)
         noisy_t = self.get_t(img, 2**t)
-        if self.target_mode == "chain":
-            target = self.get_t(img, 2 ** (t - 1))
-        else:
-            target = img
+        target = self.get_t(img, 2 ** (t - 1)) if self.target_mode == "chain" else img
         return noisy_t.astype(np.float32), target.astype(np.float32), t
 
     def __len__(self) -> int:

@@ -109,6 +109,14 @@ class ShardedLoader:
         return noisy, target, t
 
     def _make_batch(self, idxs: np.ndarray, pool: Optional[ThreadPoolExecutor] = None):
+        # native fast path: the dataset assembles the whole batch in C++
+        # threads (decode/resize/degrade/collate outside the GIL); None means
+        # "not available for this batch" → per-item python path.
+        get_batch = getattr(self.dataset, "get_batch", None)
+        if get_batch is not None:
+            batch = get_batch(idxs, num_threads=max(1, self.num_threads))
+            if batch is not None:
+                return batch
         if pool is None:
             items = [self.dataset[int(i)] for i in idxs]
         else:

@@ -1,0 +1,351 @@
+// Native host-side data pipeline for ddim_cold_tpu.
+//
+// TPU-native equivalent of the machinery the reference reaches through torch's
+// DataLoader worker *processes* (multi_gpu_trainer.py:63: num_workers=8 — PIL
+// decode + torchvision resize running in forked CPython interpreters). Under
+// SPMD there is one process per host, so the decode parallelism moves into
+// this C++ library: libjpeg/libpng decode, torch-`F.interpolate`-convention
+// resizes, the cold degradation operator D(x,t) (diffusion_loader.py:79-83),
+// and a std::thread batch assembler that fills caller-owned float32 buffers —
+// zero Python in the per-image path, fully outside the GIL.
+//
+// Resize conventions mirror ddim_cold_tpu/data/resize.py EXACTLY (they are
+// observable in training targets):
+//   nearest : src = floor(dst * in/out), clamped
+//   bilinear: half-pixel centers, src=(dst+0.5)*scale-0.5, clamp at 0,
+//             i0 = min(floor(src), in-1), i1 = min(i0+1, in-1), frac = src-i0
+//
+// Build: g++ -O3 -fPIC -shared ddim_data.cc -o libddim_data.so -ljpeg -lpng
+// Python binding: ddim_cold_tpu/data/native.py (ctypes).
+
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// decode: file → RGB8 (H, W, 3)
+// ---------------------------------------------------------------------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+void jpeg_silent(j_common_ptr, int) {}
+
+// Decode a JPEG file to RGB8. Returns nullptr on any decode error (caller
+// falls back to the PIL path). Defaults (islow DCT, fancy upsampling) match
+// PIL's, which wraps the same libjpeg.
+uint8_t* decode_jpeg(FILE* f, int* out_h, int* out_w) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_error_exit;
+  jerr.mgr.emit_message = jpeg_silent;
+  // volatile: assigned between setjmp and a possible longjmp — without it the
+  // error path would free an indeterminate register copy.
+  uint8_t* volatile buf = nullptr;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::free(buf);
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // YCbCr/gray → RGB in-library
+  jpeg_start_decompress(&cinfo);
+  const int h = cinfo.output_height, w = cinfo.output_width;
+  const int c = cinfo.output_components;
+  if (c != 3) {  // out_color_space=JCS_RGB should guarantee 3
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  buf = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(h) * w * 3));
+  if (!buf) {
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = buf + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out_h = h;
+  *out_w = w;
+  return buf;
+}
+
+// Decode a PNG file to RGB8 via the libpng simplified API (handles palette
+// expansion and gray→RGB replication, both of which match PIL convert("RGB")
+// exactly). PNGs with an alpha channel (incl. tRNS) or 16-bit depth are
+// REJECTED → PIL fallback: libpng's simplified API composites/linearizes them
+// differently from PIL, which would silently break the byte-parity contract.
+uint8_t* decode_png(FILE* f, int* out_h, int* out_w) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_stdio(&image, f)) return nullptr;
+  if (image.format & (PNG_FORMAT_FLAG_ALPHA | PNG_FORMAT_FLAG_LINEAR)) {
+    png_image_free(&image);
+    return nullptr;
+  }
+  image.format = PNG_FORMAT_RGB;
+  const size_t sz = PNG_IMAGE_SIZE(image);
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(sz));
+  if (!buf) {
+    png_image_free(&image);
+    return nullptr;
+  }
+  if (!png_image_finish_read(&image, nullptr, buf, 0, nullptr)) {
+    png_image_free(&image);
+    std::free(buf);
+    return nullptr;
+  }
+  *out_h = static_cast<int>(image.height);
+  *out_w = static_cast<int>(image.width);
+  return buf;
+}
+
+// Sniff format by magic bytes (extensions lie; unknown formats fail → the
+// Python side redoes that slot via PIL).
+uint8_t* decode_rgb8(const char* path, int* h, int* w) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  uint8_t magic[8] = {0};
+  const size_t n = std::fread(magic, 1, sizeof(magic), f);
+  std::rewind(f);
+  uint8_t* buf = nullptr;
+  if (n >= 3 && magic[0] == 0xFF && magic[1] == 0xD8 && magic[2] == 0xFF) {
+    buf = decode_jpeg(f, h, w);
+  } else if (n >= 8 && png_sig_cmp(magic, 0, 8) == 0) {
+    buf = decode_png(f, h, w);
+  }
+  std::fclose(f);
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// resize (torch F.interpolate conventions — see resize.py)
+// ---------------------------------------------------------------------------
+
+void nearest_indices(int out_size, int in_size, int* idx) {
+  const double scale = static_cast<double>(in_size) / out_size;
+  for (int i = 0; i < out_size; ++i) {
+    int v = static_cast<int>(std::floor(i * scale));
+    idx[i] = v < in_size - 1 ? v : in_size - 1;
+  }
+}
+
+struct BilinearAxis {
+  std::vector<int> i0, i1;
+  std::vector<float> frac;
+};
+
+BilinearAxis bilinear_weights(int out_size, int in_size) {
+  BilinearAxis ax;
+  ax.i0.resize(out_size);
+  ax.i1.resize(out_size);
+  ax.frac.resize(out_size);
+  const double scale = static_cast<double>(in_size) / out_size;
+  for (int i = 0; i < out_size; ++i) {
+    double src = (i + 0.5) * scale - 0.5;
+    if (src < 0.0) src = 0.0;
+    int i0 = static_cast<int>(std::floor(src));
+    if (i0 > in_size - 1) i0 = in_size - 1;
+    int i1 = i0 + 1 < in_size - 1 ? i0 + 1 : in_size - 1;
+    ax.i0[i] = i0;
+    ax.i1[i] = i1;
+    // NOTE: frac is computed against the *clamped* i0 (resize.py order) and
+    // in float32 to match `(src - i0).astype(np.float32)`.
+    ax.frac[i] = static_cast<float>(src - i0);
+  }
+  return ax;
+}
+
+// (in_h, in_w, C) float32 → (out_h, out_w, C) float32, bilinear
+// (align_corners=False, no antialias).
+void resize_bilinear_f32(const float* in, int in_h, int in_w, int c,
+                         int out_h, int out_w, float* out) {
+  const BilinearAxis ay = bilinear_weights(out_h, in_h);
+  const BilinearAxis axw = bilinear_weights(out_w, in_w);
+  for (int y = 0; y < out_h; ++y) {
+    const float fy = ay.frac[y];
+    const float* top = in + static_cast<size_t>(ay.i0[y]) * in_w * c;
+    const float* bot = in + static_cast<size_t>(ay.i1[y]) * in_w * c;
+    float* dst = out + static_cast<size_t>(y) * out_w * c;
+    for (int x = 0; x < out_w; ++x) {
+      const float fx = axw.frac[x];
+      const float* tl = top + static_cast<size_t>(axw.i0[x]) * c;
+      const float* tr = top + static_cast<size_t>(axw.i1[x]) * c;
+      const float* bl = bot + static_cast<size_t>(axw.i0[x]) * c;
+      const float* br = bot + static_cast<size_t>(axw.i1[x]) * c;
+      for (int ch = 0; ch < c; ++ch) {
+        // match resize.py's operation order: rows = top·(1−fy)+bot·fy, then
+        // left·(1−fx)+right·fx — float32 throughout for bit parity.
+        const float left = tl[ch] * (1.0f - fy) + bl[ch] * fy;
+        const float right = tr[ch] * (1.0f - fy) + br[ch] * fy;
+        dst[x * c + ch] = left * (1.0f - fx) + right * fx;
+      }
+    }
+  }
+}
+
+void resize_nearest_f32(const float* in, int in_h, int in_w, int c,
+                        int out_h, int out_w, float* out) {
+  std::vector<int> iy(out_h), ix(out_w);
+  nearest_indices(out_h, in_h, iy.data());
+  nearest_indices(out_w, in_w, ix.data());
+  for (int y = 0; y < out_h; ++y) {
+    const float* row = in + static_cast<size_t>(iy[y]) * in_w * c;
+    float* dst = out + static_cast<size_t>(y) * out_w * c;
+    for (int x = 0; x < out_w; ++x)
+      std::memcpy(dst + static_cast<size_t>(x) * c,
+                  row + static_cast<size_t>(ix[x]) * c, sizeof(float) * c);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// item pipelines
+// ---------------------------------------------------------------------------
+
+// decode → /255 → bilinear(out_h, out_w) → ·2−1  (datasets.py _load_base /
+// reference diffusion_loader.py:47-49 order). out: (out_h, out_w, 3) f32.
+int load_base_impl(const char* path, int out_h, int out_w, float* out) {
+  int h = 0, w = 0;
+  uint8_t* rgb = decode_rgb8(path, &h, &w);
+  if (!rgb) return 1;
+  std::vector<float> unit(static_cast<size_t>(h) * w * 3);
+  const size_t n = unit.size();
+  // divide (not multiply-by-reciprocal): bit parity with numpy's `/ 255.0`
+  for (size_t i = 0; i < n; ++i) unit[i] = rgb[i] / 255.0f;
+  std::free(rgb);
+  resize_bilinear_f32(unit.data(), h, w, 3, out_h, out_w, out);
+  const size_t m = static_cast<size_t>(out_h) * out_w * 3;
+  for (size_t i = 0; i < m; ++i) out[i] = out[i] * 2.0f - 1.0f;
+  return 0;
+}
+
+// D(x, 2^t): nearest down to max(⌊size/2^t⌋, 1), nearest back up.
+void cold_degrade_impl(const float* img, int size, int c, int level_scale,
+                       float* out) {
+  int target = size / level_scale;  // floor for positive ints
+  if (target < 1) target = 1;
+  if (target == size) {  // s=1 identity
+    std::memcpy(out, img, sizeof(float) * static_cast<size_t>(size) * size * c);
+    return;
+  }
+  std::vector<float> small(static_cast<size_t>(target) * target * c);
+  resize_nearest_f32(img, size, size, c, target, target, small.data());
+  resize_nearest_f32(small.data(), target, target, c, size, size, out);
+}
+
+// One cold-dataset item: (D(x,t), D(x,t−1) | x₀, t) — diffusion_loader.py:84-97.
+int cold_item_impl(const char* path, int size, int t, int chain, float* noisy,
+                   float* target) {
+  std::vector<float> base(static_cast<size_t>(size) * size * 3);
+  if (load_base_impl(path, size, size, base.data())) return 1;
+  cold_degrade_impl(base.data(), size, 3, 1 << t, noisy);
+  if (chain) {
+    cold_degrade_impl(base.data(), size, 3, 1 << (t - 1), target);
+  } else {
+    std::memcpy(target, base.data(), sizeof(float) * base.size());
+  }
+  return 0;
+}
+
+// Simple work-stealing-free parallel for: threads pull indices off an atomic.
+template <typename Fn>
+int parallel_items(int n, int num_threads, Fn&& fn) {
+  if (num_threads < 1) num_threads = 1;
+  if (num_threads > n) num_threads = n;
+  std::atomic<int> next(0), failures(0);
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1))
+      if (fn(i)) failures.fetch_add(1);
+  };
+  if (num_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(num_threads);
+    for (int i = 0; i < num_threads; ++i) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failures.load();
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+const char* ddim_native_version() { return "ddim_data 1"; }
+
+// file → (out_h, out_w, 3) float32 in [−1, 1]. Returns 0 on success.
+int ddim_load_base(const char* path, int out_h, int out_w, float* out) {
+  return load_base_impl(path, out_h, out_w, out);
+}
+
+// (size, size, c) float32 → D(x, level_scale) into out (same shape).
+void ddim_cold_degrade(const float* img, int size, int c, int level_scale,
+                       float* out) {
+  cold_degrade_impl(img, size, c, level_scale, out);
+}
+
+int ddim_cold_item(const char* path, int size, int t, int chain, float* noisy,
+                   float* target) {
+  return cold_item_impl(path, size, t, chain, noisy, target);
+}
+
+// Batch of cold items into pre-allocated (n, size, size, 3) float32 buffers.
+// Returns the number of FAILED items (0 = all good); failed slots are
+// untouched and `failed`, when non-null, is an n-int32 mask the Python side
+// uses to re-do stragglers via PIL.
+int ddim_cold_batch(const char** paths, const int32_t* ts, int n, int size,
+                    int chain, int num_threads, float* noisy, float* target,
+                    int32_t* failed) {
+  const size_t stride = static_cast<size_t>(size) * size * 3;
+  if (failed) std::memset(failed, 0, sizeof(int32_t) * n);
+  return parallel_items(n, num_threads, [&](int i) -> int {
+    const int rc = cold_item_impl(paths[i], size, ts[i], chain,
+                                  noisy + stride * i, target + stride * i);
+    if (rc && failed) failed[i] = 1;
+    return rc;
+  });
+}
+
+// Batch of decoded+resized base images ([−1,1]) — the shared front half of
+// the Gaussian dataset (noise stays in numpy for Philox-stream parity).
+int ddim_base_batch(const char** paths, int n, int out_h, int out_w,
+                    int num_threads, float* out, int32_t* failed) {
+  const size_t stride = static_cast<size_t>(out_h) * out_w * 3;
+  if (failed) std::memset(failed, 0, sizeof(int32_t) * n);
+  return parallel_items(n, num_threads, [&](int i) -> int {
+    const int rc = load_base_impl(paths[i], out_h, out_w, out + stride * i);
+    if (rc && failed) failed[i] = 1;
+    return rc;
+  });
+}
+
+}  // extern "C"

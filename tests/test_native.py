@@ -1,0 +1,141 @@
+"""Native C++ data-pipeline parity (native/ddim_data.cc via data/native.py).
+
+The native path must be a pure accelerator: byte-for-byte the same tensors as
+the PIL/numpy reference path (datasets.py / resize.py) on the formats it
+handles, and a graceful fallback everywhere else. JPEG decode parity is exact
+because PIL wraps the same libjpeg with the same defaults; the resize math is
+written to match resize.py's float32 operation order.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from ddim_cold_tpu.data import native, resize
+from ddim_cold_tpu.data.datasets import (
+    ColdDownSampleDataset,
+    DiffusionDataset,
+    _load_base,
+)
+from ddim_cold_tpu.data.loader import ShardedLoader
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def mixed_image_dir(tmp_path_factory):
+    """jpg + png + bmp (bmp exercises the PIL fallback inside native batches)."""
+    root = tmp_path_factory.mktemp("mixed_imgs")
+    rs = np.random.RandomState(7)
+    for i, ext in enumerate(["jpg", "jpg", "png", "png", "bmp", "jpg"]):
+        arr = rs.randint(0, 255, size=(70 + i, 90 - i, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / f"{i}.{ext}")
+    # a grayscale png (native must replicate channels like PIL convert("RGB"))
+    Image.fromarray(rs.randint(0, 255, size=(50, 40), dtype=np.uint8)).save(
+        root / "9_gray.png")
+    return str(root)
+
+
+def test_load_base_parity(mixed_image_dir):
+    for name in sorted(os.listdir(mixed_image_dir)):
+        path = os.path.join(mixed_image_dir, name)
+        via_pil = _load_base(path, (64, 64), use_native=False)
+        via_native = native.load_base(path, (64, 64))
+        if os.path.splitext(name)[1] == ".bmp":
+            assert via_native is None  # unsupported → caller falls back
+            continue
+        assert via_native is not None, name
+        np.testing.assert_array_equal(via_native, via_pil.astype(np.float32),
+                                      err_msg=name)
+
+
+def test_png_alpha_and_16bit_rejected(tmp_path):
+    """PNGs whose PIL conversion libpng can't reproduce exactly (alpha
+    composite, 16-bit scaling) must be REJECTED → PIL fallback, not silently
+    decoded differently."""
+    rs = np.random.RandomState(3)
+    rgba = tmp_path / "a.png"
+    Image.fromarray(rs.randint(0, 255, (32, 32, 4), dtype=np.uint8), "RGBA").save(rgba)
+    i16 = tmp_path / "b.png"
+    Image.fromarray(rs.randint(0, 65535, (32, 32), dtype=np.uint16)).save(i16)
+    for path in (rgba, i16):
+        assert native.load_base(str(path), (16, 16)) is None
+        # and the dataset path still produces the PIL result
+        got = _load_base(str(path), (16, 16))
+        want = _load_base(str(path), (16, 16), use_native=False)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_cold_degrade_parity(rng):
+    img = rng.randn(64, 64, 3).astype(np.float32)
+    for t in range(1, 7):
+        want = resize.cold_degrade(img, 2**t, 64)
+        got = native.cold_degrade(img, 2**t)
+        np.testing.assert_array_equal(got, want, err_msg=f"t={t}")
+
+
+@pytest.mark.parametrize("mode", ["chain", "direct"])
+def test_cold_item_and_batch_parity(mixed_image_dir, mode):
+    ds_native = ColdDownSampleDataset(mixed_image_dir, (64, 64), target_mode=mode)
+    ds_pil = ColdDownSampleDataset(mixed_image_dir, (64, 64), target_mode=mode,
+                                   use_native=False)
+    n = len(ds_native)
+    # per-item parity (same seed ⇒ same t draws)
+    for i in range(n):
+        a_noisy, a_target, a_t = ds_native[i]
+        b_noisy, b_target, b_t = ds_pil[i]
+        assert a_t == b_t
+        np.testing.assert_array_equal(a_noisy, b_noisy)
+        np.testing.assert_array_equal(a_target, b_target)
+    # batch fast path (includes the bmp fallback slot)
+    batch = ds_native.get_batch(list(range(n)))
+    assert batch is not None
+    noisy, target, ts = batch
+    for i in range(n):
+        b_noisy, b_target, b_t = ds_pil[i]
+        assert int(ts[i]) == b_t
+        np.testing.assert_array_equal(noisy[i], b_noisy)
+        np.testing.assert_array_equal(target[i], b_target)
+
+
+def test_gaussian_batch_parity(synthetic_image_dir):
+    ds_native = DiffusionDataset(synthetic_image_dir, (32, 32), max_step=100)
+    ds_pil = DiffusionDataset(synthetic_image_dir, (32, 32), max_step=100,
+                              use_native=False)
+    batch = ds_native.get_batch(list(range(len(ds_native))))
+    assert batch is not None
+    noisy, target, ts = batch
+    for i in range(len(ds_pil)):
+        b_noisy, b_target, b_t = ds_pil[i]
+        assert int(ts[i]) == b_t
+        np.testing.assert_array_equal(noisy[i], b_noisy)
+        np.testing.assert_array_equal(target[i], b_target)
+
+
+def test_loader_uses_native_batches(mixed_image_dir):
+    """End-to-end: the loader's batches are identical with and without the
+    native backend (shuffle order is loader-side, decode is dataset-side)."""
+    kwargs = dict(batch_size=3, shuffle=True, seed=5, drop_last=True)
+    l_native = ShardedLoader(ColdDownSampleDataset(mixed_image_dir, (64, 64)), **kwargs)
+    l_pil = ShardedLoader(
+        ColdDownSampleDataset(mixed_image_dir, (64, 64), use_native=False), **kwargs)
+    for (an, at, att), (bn, bt, btt) in zip(l_native, l_pil):
+        np.testing.assert_array_equal(an, bn)
+        np.testing.assert_array_equal(at, bt)
+        np.testing.assert_array_equal(att, btt)
+
+
+def test_env_kill_switch(monkeypatch, synthetic_image_dir):
+    """DDIM_COLD_NO_NATIVE force-disables the library for new loads."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_failed", False)
+    monkeypatch.setenv("DDIM_COLD_NO_NATIVE", "1")
+    assert not native.available()
+    ds = DiffusionDataset(synthetic_image_dir, (32, 32))
+    assert ds.get_batch([0, 1]) is None  # → loader per-item path
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_failed", False)
