@@ -120,6 +120,11 @@ class DiffusionDataset:
         if res is None:
             return None
         base, failed = res
+        if failed.all():
+            # native can't decode any of this batch (e.g. a webp/bmp dataset) —
+            # let the loader's parallel per-item path handle it instead of
+            # repairing the whole batch sequentially here.
+            return None
         for j, i in enumerate(indices):
             if failed[j]:
                 base[j] = _load_base(paths[j], self.img_size, use_native=False)
@@ -200,6 +205,9 @@ class ColdDownSampleDataset:
         if res is None:
             return None
         noisy, target, failed = res
+        if failed.all():
+            # fully non-native batch → loader's parallel per-item path
+            return None
         for j, i in enumerate(indices):
             if failed[j]:
                 noisy[j], target[j], _ = self._pil_item(int(i), ts[j])

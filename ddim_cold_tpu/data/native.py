@@ -38,14 +38,23 @@ def _build() -> bool:
     src = os.path.join(_NATIVE_DIR, "ddim_data.cc")
     if not os.path.isfile(src):
         return False
+    # compile to a per-process temp name, then atomically rename: concurrent
+    # processes (multi-host on a shared fs, pytest-xdist) must never dlopen a
+    # half-written .so.
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-fPIC", "-std=c++17", "-ffp-contract=off", "-shared",
-             src, "-o", _SO_PATH, "-ljpeg", "-lpng", "-lpthread"],
+             src, "-o", tmp, "-ljpeg", "-lpng", "-lpthread"],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _SO_PATH)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -1,0 +1,20 @@
+from ddim_cold_tpu.eval.fid import (
+    ActivationStats,
+    compute_fid,
+    fid_between,
+    fid_from_stats,
+    frechet_distance,
+    make_feature_fn,
+)
+from ddim_cold_tpu.eval.inception import InceptionV3Features, load_torch_inception
+
+__all__ = [
+    "ActivationStats",
+    "compute_fid",
+    "fid_between",
+    "fid_from_stats",
+    "frechet_distance",
+    "make_feature_fn",
+    "InceptionV3Features",
+    "load_torch_inception",
+]

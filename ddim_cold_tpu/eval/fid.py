@@ -1,0 +1,189 @@
+"""FID — Fréchet Inception Distance (streaming statistics + distance).
+
+The north-star acceptance metric (BASELINE.json: "FID within 0.5 of the CUDA
+reference"); the reference codebase itself has NO quantitative image metric
+(samples are compared by eye, reference README.md:24), so this subsystem is a
+required new build per SURVEY.md §7.
+
+Pieces:
+* ``ActivationStats`` — streaming (count, Σx, Σxxᵀ) accumulator; batches can
+  arrive from any loader/sampler, memory is O(d²) regardless of sample count.
+* ``frechet_distance`` — ‖μ₁−μ₂‖² + tr(Σ₁+Σ₂−2(Σ₁Σ₂)^½), with the matrix
+  square root via symmetric eigendecomposition (no scipy dependency in the
+  hot path; scipy.linalg.sqrtm is cross-checked in tests).
+* ``compute_fid`` / ``fid_between`` — end-to-end: images in [0,1] → 299×299
+  bilinear resize → [−1,1] → InceptionV3 pool3 features (jitted, batched) →
+  statistics → distance.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.eval import inception
+
+
+@dataclass
+class ActivationStats:
+    """Streaming mean/covariance of feature activations."""
+
+    dim: int
+    count: int = 0
+    _sum: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
+    _outer: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self._sum is None:
+            self._sum = np.zeros(self.dim, np.float64)
+        if self._outer is None:
+            self._outer = np.zeros((self.dim, self.dim), np.float64)
+
+    def update(self, feats: np.ndarray) -> None:
+        feats = np.asarray(feats, np.float64)
+        if feats.ndim != 2 or feats.shape[1] != self.dim:
+            raise ValueError(f"expected (N, {self.dim}) features, got {feats.shape}")
+        self.count += feats.shape[0]
+        self._sum += feats.sum(axis=0)
+        self._outer += feats.T @ feats
+
+    def merge(self, other: "ActivationStats") -> "ActivationStats":
+        """Combine two accumulators (e.g. per-host shards)."""
+        if other.dim != self.dim:
+            raise ValueError("dimension mismatch")
+        out = ActivationStats(self.dim)
+        out.count = self.count + other.count
+        out._sum = self._sum + other._sum
+        out._outer = self._outer + other._outer
+        return out
+
+    @property
+    def mean(self) -> np.ndarray:
+        if self.count < 1:
+            raise ValueError("no samples accumulated")
+        return self._sum / self.count
+
+    @property
+    def cov(self) -> np.ndarray:
+        """Unbiased (N−1) covariance — matches np.cov / pytorch-fid."""
+        if self.count < 2:
+            raise ValueError("need ≥2 samples for covariance")
+        mu = self.mean
+        return (self._outer - self.count * np.outer(mu, mu)) / (self.count - 1)
+
+
+def _sqrtm_psd(mat: np.ndarray, eps: float = 0.0) -> np.ndarray:
+    """Symmetric-PSD matrix square root via eigh (negative eigenvalues from
+    round-off are clamped to 0)."""
+    w, v = np.linalg.eigh(mat)
+    return (v * np.sqrt(np.clip(w, eps, None))) @ v.T
+
+
+def trace_sqrt_product(sigma1: np.ndarray, sigma2: np.ndarray) -> float:
+    """tr((Σ₁Σ₂)^½) computed stably: tr((Σ₁^½ Σ₂ Σ₁^½)^½) — the inner matrix
+    is symmetric PSD, so everything stays in real symmetric eigensolves
+    (scipy.sqrtm on the non-symmetric product can go complex)."""
+    s1h = _sqrtm_psd(np.asarray(sigma1, np.float64))
+    inner = s1h @ np.asarray(sigma2, np.float64) @ s1h
+    inner = (inner + inner.T) / 2.0
+    w = np.linalg.eigvalsh(inner)
+    return float(np.sqrt(np.clip(w, 0.0, None)).sum())
+
+
+def frechet_distance(mu1, sigma1, mu2, sigma2) -> float:
+    """d²((μ₁,Σ₁), (μ₂,Σ₂)) = ‖μ₁−μ₂‖² + tr(Σ₁) + tr(Σ₂) − 2·tr((Σ₁Σ₂)^½)."""
+    mu1, mu2 = np.asarray(mu1, np.float64), np.asarray(mu2, np.float64)
+    sigma1, sigma2 = np.asarray(sigma1, np.float64), np.asarray(sigma2, np.float64)
+    diff = float(((mu1 - mu2) ** 2).sum())
+    return diff + float(np.trace(sigma1) + np.trace(sigma2)) \
+        - 2.0 * trace_sqrt_product(sigma1, sigma2)
+
+
+def fid_from_stats(a: ActivationStats, b: ActivationStats) -> float:
+    return frechet_distance(a.mean, a.cov, b.mean, b.cov)
+
+
+# ---------------------------------------------------------------------------
+# feature extraction pipeline
+# ---------------------------------------------------------------------------
+
+def make_feature_fn(model=None, variables=None) -> tuple[Callable, int]:
+    """Returns ``(feature_fn, dim)`` where ``feature_fn(images_01)`` maps a
+    [0,1] NHWC batch (any resolution) to pool3 features.
+
+    With no arguments, uses a random-init InceptionV3 — a valid metric space
+    for smoke tests/regression tracking but NOT comparable to published FID
+    numbers; pass variables converted from torch weights
+    (inception.load_torch_inception) for those.
+    """
+    if model is None or variables is None:
+        model, variables = inception.init_variables(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def feature_fn(images_01):
+        x = jnp.clip(images_01, 0.0, 1.0)
+        x = jax.image.resize(
+            x, (x.shape[0], inception.INCEPTION_SIZE, inception.INCEPTION_SIZE,
+                x.shape[3]), method="bilinear")
+        x = x * 2.0 - 1.0  # the FID-inception normalization (mean=std=0.5)
+        return model.apply(variables, x)
+
+    return feature_fn, inception.FEATURE_DIM
+
+
+def stats_for_batches(batches: Iterable[np.ndarray], feature_fn: Callable,
+                      dim: int = inception.FEATURE_DIM) -> ActivationStats:
+    """Accumulate activation statistics over an iterable of [0,1] NHWC batches."""
+    stats = ActivationStats(dim)
+    for batch in batches:
+        stats.update(np.asarray(feature_fn(jnp.asarray(batch))))
+    return stats
+
+
+def fid_between(real_batches: Iterable[np.ndarray],
+                fake_batches: Iterable[np.ndarray],
+                model=None, variables=None) -> float:
+    """End-to-end FID between two streams of [0,1] image batches."""
+    feature_fn, dim = make_feature_fn(model, variables)
+    real = stats_for_batches(real_batches, feature_fn, dim)
+    fake = stats_for_batches(fake_batches, feature_fn, dim)
+    return fid_from_stats(real, fake)
+
+
+def compute_fid(
+    model,
+    params,
+    real_batches: Iterable[np.ndarray],
+    *,
+    rng: jax.Array,
+    n_samples: int = 1024,
+    sample_batch: int = 64,
+    k: int = 20,
+    inception_model=None,
+    inception_variables=None,
+    sampler: Optional[Callable] = None,
+) -> float:
+    """FID of a diffusion model's samples against a real-image stream.
+
+    ``model/params`` are the DiffusionViT; samples are drawn with
+    ``ops.sampling.ddim_sample`` at stride ``k`` (the north-star metric path:
+    200px, k=20) unless a custom ``sampler(rng, n) → [0,1] images`` is given.
+    """
+    from ddim_cold_tpu.ops import sampling
+
+    feature_fn, dim = make_feature_fn(inception_model, inception_variables)
+    real = stats_for_batches(real_batches, feature_fn, dim)
+    fake = ActivationStats(dim)
+    remaining = n_samples
+    while remaining > 0:
+        n = min(sample_batch, remaining)
+        rng, sub = jax.random.split(rng)
+        imgs = (sampler(sub, n) if sampler is not None
+                else sampling.ddim_sample(model, params, sub, k=k, n=n))
+        fake.update(np.asarray(feature_fn(imgs)))
+        remaining -= n
+    return fid_from_stats(real, fake)

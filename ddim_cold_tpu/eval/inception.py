@@ -1,0 +1,248 @@
+"""InceptionV3 feature extractor (Flax) for FID.
+
+The reference has no evaluation metric at all (its acceptance artifact is
+sample PNGs compared by eye, reference README.md:24); the north-star target is
+FID, so this subsystem is new-build per SURVEY.md §7 ("FID evaluation infra
+... must be added (InceptionV3 in Flax + activation statistics)").
+
+Architecture mirrors torchvision's ``inception_v3`` (aux head omitted — FID
+reads the 2048-d pool3 features), with module names matching the torch
+state_dict (``Conv2d_1a_3x3``, ``Mixed_5b.branch1x1`` …) so that
+``flax_from_torch_inception`` is a purely mechanical layout transform. Feed it
+a torchvision ``Inception_V3_Weights`` state_dict — or the pytorch-fid port of
+the original TF weights for numbers comparable with published FID scores (the
+two differ slightly; FID is only comparable under a fixed extractor either
+way).
+
+All convs run in NHWC (TPU-native layout); BatchNorm uses stored running
+statistics (inference only).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: canonical FID input resolution
+INCEPTION_SIZE = 299
+#: pool3 feature width
+FEATURE_DIM = 2048
+
+
+class BasicConv2d(nn.Module):
+    """Conv(bias=False) → BatchNorm(eps=1e-3, running stats) → ReLU."""
+
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: Any = (0, 0)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        pad = self.padding
+        if isinstance(pad, tuple) and isinstance(pad[0], int):
+            pad = ((pad[0], pad[0]), (pad[1], pad[1]))
+        x = nn.Conv(self.features, self.kernel, strides=self.strides, padding=pad,
+                    use_bias=False, dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9,
+                         dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_3x3_same(x: jax.Array) -> jax.Array:
+    """torch avg_pool2d(k=3, s=1, p=1, count_include_pad=True): zero-pad then
+    divide by 9 everywhere — NOT the edge-renormalizing 'SAME' average."""
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1),
+                              [(0, 0), (1, 1), (1, 1), (0, 0)])
+    return s / 9.0
+
+
+def _max_pool_3x3_s2(x: jax.Array) -> jax.Array:
+    return nn.max_pool(x, (3, 3), strides=(2, 2))
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(BasicConv2d, dtype=self.dtype)
+        b1 = c(64, (1, 1), name="branch1x1")(x)
+        b5 = c(48, (1, 1), name="branch5x5_1")(x)
+        b5 = c(64, (5, 5), padding=(2, 2), name="branch5x5_2")(b5)
+        b3 = c(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = c(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3)
+        b3 = c(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3)
+        bp = c(self.pool_features, (1, 1), name="branch_pool")(_avg_pool_3x3_same(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(BasicConv2d, dtype=self.dtype)
+        b3 = c(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
+        bd = c(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = c(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bd = c(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+        return jnp.concatenate([b3, bd, _max_pool_3x3_s2(x)], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(BasicConv2d, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = c(192, (1, 1), name="branch1x1")(x)
+        b7 = c(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = c(c7, (1, 7), padding=(0, 3), name="branch7x7_2")(b7)
+        b7 = c(192, (7, 1), padding=(3, 0), name="branch7x7_3")(b7)
+        bd = c(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = c(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_2")(bd)
+        bd = c(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd)
+        bd = c(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd)
+        bd = c(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd)
+        bp = c(192, (1, 1), name="branch_pool")(_avg_pool_3x3_same(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(BasicConv2d, dtype=self.dtype)
+        b3 = c(192, (1, 1), name="branch3x3_1")(x)
+        b3 = c(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
+        b7 = c(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = c(192, (1, 7), padding=(0, 3), name="branch7x7x3_2")(b7)
+        b7 = c(192, (7, 1), padding=(3, 0), name="branch7x7x3_3")(b7)
+        b7 = c(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+        return jnp.concatenate([b3, b7, _max_pool_3x3_s2(x)], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(BasicConv2d, dtype=self.dtype)
+        b1 = c(320, (1, 1), name="branch1x1")(x)
+        b3 = c(384, (1, 1), name="branch3x3_1")(x)
+        b3 = jnp.concatenate([
+            c(384, (1, 3), padding=(0, 1), name="branch3x3_2a")(b3),
+            c(384, (3, 1), padding=(1, 0), name="branch3x3_2b")(b3),
+        ], axis=-1)
+        bd = c(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = c(384, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bd = jnp.concatenate([
+            c(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd),
+            c(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd),
+        ], axis=-1)
+        bp = c(192, (1, 1), name="branch_pool")(_avg_pool_3x3_same(x))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3Features(nn.Module):
+    """NHWC [−1, 1] images at 299×299 → (N, 2048) pool3 features."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = partial(BasicConv2d, dtype=self.dtype)
+        x = c(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = c(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = c(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x)
+        x = _max_pool_3x3_s2(x)
+        x = c(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = c(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = _max_pool_3x3_s2(x)
+        x = InceptionA(32, dtype=self.dtype, name="Mixed_5b")(x)
+        x = InceptionA(64, dtype=self.dtype, name="Mixed_5c")(x)
+        x = InceptionA(64, dtype=self.dtype, name="Mixed_5d")(x)
+        x = InceptionB(dtype=self.dtype, name="Mixed_6a")(x)
+        x = InceptionC(128, dtype=self.dtype, name="Mixed_6b")(x)
+        x = InceptionC(160, dtype=self.dtype, name="Mixed_6c")(x)
+        x = InceptionC(160, dtype=self.dtype, name="Mixed_6d")(x)
+        x = InceptionC(192, dtype=self.dtype, name="Mixed_6e")(x)
+        x = InceptionD(dtype=self.dtype, name="Mixed_7a")(x)
+        x = InceptionE(dtype=self.dtype, name="Mixed_7b")(x)
+        x = InceptionE(dtype=self.dtype, name="Mixed_7c")(x)
+        return jnp.mean(x, axis=(1, 2))  # global average pool → (N, 2048)
+
+
+def init_variables(rng: jax.Array, dtype=jnp.float32):
+    """Random-init variables (params + batch_stats). Random features still
+    define a valid (if non-comparable) metric space — the unit tests and the
+    smoke path use this; real FID needs converted torch weights."""
+    model = InceptionV3Features(dtype=dtype)
+    tiny = jnp.zeros((1, INCEPTION_SIZE, INCEPTION_SIZE, 3), dtype)
+    return model, model.init(rng, tiny)
+
+
+def flax_from_torch_inception(state_dict: dict) -> dict:
+    """torchvision ``inception_v3`` state_dict → {'params', 'batch_stats'}.
+
+    Layout transforms only: conv ``(O, I, kh, kw)`` → ``(kh, kw, I, O)``;
+    bn weight/bias → scale/bias, running_mean/var → batch_stats. The aux head
+    (``AuxLogits.*``) and the classifier ``fc.*`` are ignored.
+    """
+    to_np = lambda v: np.asarray(
+        v.detach().cpu().numpy() if hasattr(v, "detach") else v, np.float32)
+    params: dict = {}
+    stats: dict = {}
+
+    def put(tree, path, leaf):
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+
+    for key, value in state_dict.items():
+        if key.startswith(("AuxLogits.", "fc.")):
+            continue
+        parts = key.split(".")
+        mod_path, leaf_name = parts[:-1], parts[-1]
+        v = to_np(value)
+        if leaf_name == "weight" and mod_path[-1] == "conv":
+            put(params, mod_path + ["kernel"], v.transpose(2, 3, 1, 0))
+        elif mod_path[-1] == "bn":
+            if leaf_name == "weight":
+                put(params, mod_path + ["scale"], v)
+            elif leaf_name == "bias":
+                put(params, mod_path + ["bias"], v)
+            elif leaf_name == "running_mean":
+                put(stats, mod_path + ["mean"], v)
+            elif leaf_name == "running_var":
+                put(stats, mod_path + ["var"], v)
+            # num_batches_tracked: irrelevant at inference
+        elif leaf_name == "bias" and mod_path[-1] == "conv":
+            put(params, mod_path + ["bias"], v)  # not present in torchvision
+        else:
+            raise ValueError(f"unexpected torch key {key!r}")
+    return {"params": params, "batch_stats": stats}
+
+
+def load_torch_inception(path: str):
+    """Load a torchvision inception_v3 ``.pth`` checkpoint → (model, variables).
+    torch is a conversion-time-only dependency (same policy as
+    utils/checkpoint.py)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    if not isinstance(sd, dict):
+        sd = sd.state_dict()
+    return InceptionV3Features(), flax_from_torch_inception(sd)
