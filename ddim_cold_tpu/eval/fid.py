@@ -118,10 +118,18 @@ def make_feature_fn(model=None, variables=None) -> tuple[Callable, int]:
     With no arguments, uses a random-init InceptionV3 — a valid metric space
     for smoke tests/regression tracking but NOT comparable to published FID
     numbers; pass variables converted from torch weights
-    (inception.load_torch_inception) for those.
+    (inception.load_torch_inception) for those. Passing only ``variables``
+    pairs them with a default ``InceptionV3Features()``; a model without
+    variables is an error (random init would silently corrupt the metric).
     """
-    if model is None or variables is None:
+    if variables is None:
+        if model is not None:
+            raise ValueError(
+                "inception model given without variables — refusing to pair "
+                "real weights' architecture with random init")
         model, variables = inception.init_variables(jax.random.PRNGKey(0))
+    elif model is None:
+        model = inception.InceptionV3Features()
 
     @jax.jit
     def feature_fn(images_01):
@@ -180,10 +188,13 @@ def compute_fid(
     fake = ActivationStats(dim)
     remaining = n_samples
     while remaining > 0:
-        n = min(sample_batch, remaining)
+        # always sample a full batch (static shape → one sampler/inception
+        # compile); surplus features of the final batch are dropped before
+        # they reach the statistics.
+        keep = min(sample_batch, remaining)
         rng, sub = jax.random.split(rng)
-        imgs = (sampler(sub, n) if sampler is not None
-                else sampling.ddim_sample(model, params, sub, k=k, n=n))
-        fake.update(np.asarray(feature_fn(imgs)))
-        remaining -= n
+        imgs = (sampler(sub, sample_batch) if sampler is not None
+                else sampling.ddim_sample(model, params, sub, k=k, n=sample_batch))
+        fake.update(np.asarray(feature_fn(imgs))[:keep])
+        remaining -= keep
     return fid_from_stats(real, fake)

@@ -141,7 +141,7 @@ def save_torch_pkl(params, path: str, patch_size: int) -> None:
     """Write params as a torch state_dict pickle a reference user can load."""
     import torch
 
-    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+    sd = {k: torch.from_numpy(np.array(v, order="C"))
           for k, v in torch_state_dict_from_flax(params, patch_size).items()}
     torch.save(sd, path)
 

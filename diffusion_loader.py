@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Dataset module shim — the reference's ``diffusion_loader.py`` import surface.
+
+Reference users do ``from diffusion_loader import ColdDownSampleDataset`` (so does
+its trainer, multi_gpu_trainer.py:5); this module re-exports the TPU-native
+implementations from ``ddim_cold_tpu.data`` under the reference names, including
+the ``_au`` paper-variant class (diffusion_loader.py:99-138: targets the clean
+x₀ directly instead of the one-level-up chain target).
+
+``python diffusion_loader.py [image_dir]`` runs the dataset visual check
+(reference diffusion_loader.py:141-154): for each level t = 1..max_step it
+renders the ``(D(x,t), target)`` pair of the first item and writes
+``degradation_pairs.png`` — headless-friendly (saved, not shown). Without an
+argument it degrades a synthetic gradient image so the check runs out of the box.
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+from ddim_cold_tpu.data import (  # noqa: E402,F401
+    ColdDownSampleDataset,
+    DiffusionDataset,
+    pil_loader,
+)
+
+
+class ColdDownSampleDataset_au(ColdDownSampleDataset):
+    """Paper variant: ``(D(x,t), x₀, t)`` (reference diffusion_loader.py:99-138)."""
+
+    def __init__(self, root, imgSize=(32, 32), **kwargs):
+        kwargs.pop("target_mode", None)
+        super().__init__(root, imgSize=imgSize, target_mode="direct", **kwargs)
+
+
+def _synthetic_dir(size: int = 64) -> str:
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    root = tempfile.mkdtemp(prefix="ddim_cold_viz_")
+    y, x = np.mgrid[0:size, 0:size].astype(np.float32) / (size - 1)
+    arr = np.stack([x, y, 0.5 * (x + y)], axis=-1)
+    Image.fromarray((arr * 255).astype(np.uint8)).save(os.path.join(root, "grad.png"))
+    return root
+
+
+def main(argv):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    root = argv[1] if len(argv) > 1 else _synthetic_dir()
+    out = argv[2] if len(argv) > 2 else os.path.join(HERE, "degradation_pairs.png")
+    ds = ColdDownSampleDataset(root, imgSize=(64, 64))
+    fig, axes = plt.subplots(2, ds.max_step, figsize=(2 * ds.max_step, 4.2))
+    for t in range(1, ds.max_step + 1):
+        noisy, target, _ = ds.__getitem__(0, t=t)
+        for row, img, label in ((0, noisy, f"D(x,{t})"), (1, target, f"D(x,{t - 1})")):
+            ax = axes[row][t - 1]
+            ax.imshow(np.clip((np.asarray(img) + 1) / 2, 0, 1))
+            ax.set_title(label, fontsize=8)
+            ax.axis("off")
+    fig.tight_layout()
+    fig.savefig(out, dpi=110)
+    print(f"degradation pairs (t=1..{ds.max_step}) → {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
