@@ -22,8 +22,9 @@ New optional keys (defaulted so reference YAMLs run unchanged):
 ``dataset`` (cold | cold_direct | gaussian — the trainer hardwires cold,
 multi_gpu_trainer.py:5,59), ``seed``, ``honor_diff_step``, ``mesh`` (axis
 sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``), ``use_flash``
-(Pallas fused attention, recommended for the 200px configs) and
-``use_sincos_pos`` (fixed sinusoidal positional table, C7).
+(Pallas fused attention, recommended for the 200px configs),
+``use_sincos_pos`` (fixed sinusoidal positional table, C7) and ``remat``
+(gradient checkpointing per block — HBM for FLOPs on big configs).
 """
 
 from __future__ import annotations
@@ -59,6 +60,7 @@ class ExperimentConfig:
     mesh: Optional[dict[str, int]] = None
     use_flash: bool = False
     use_sincos_pos: bool = False
+    remat: bool = False
 
     @property
     def effective_batch(self) -> int:
@@ -102,6 +104,7 @@ class ExperimentConfig:
             total_steps=self.total_steps,
             use_flash=self.use_flash,
             use_sincos_pos=self.use_sincos_pos,
+            remat=self.remat,
         )
 
 
@@ -134,4 +137,5 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         mesh=raw.get("mesh"),
         use_flash=bool(raw.get("use_flash", False)),
         use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
+        remat=bool(raw.get("remat", False)),
     )

@@ -287,6 +287,8 @@ class DiffusionViT(nn.Module):
     dtype: Dtype = jnp.float32
     use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
     use_flash: bool = False  # Pallas fused attention (long-seq configs)
+    remat: bool = False  # jax.checkpoint each block: recompute activations in
+    # backward instead of holding depth× residuals in HBM (big-config training)
 
     @property
     def num_patches(self) -> int:
@@ -337,8 +339,11 @@ class DiffusionViT(nn.Module):
 
         # stochastic depth decay rule: linspace(0, rate, depth) (ViT.py:176)
         dpr = np.linspace(0.0, self.drop_path_rate, self.depth)
+        # deterministic (argnum 2; 0 is the module) is a Python bool steering
+        # trace-time structure — it must stay static under jax.checkpoint.
+        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
         for i in range(self.depth):
-            blk = Block(
+            blk_kwargs = dict(
                 dim=E,
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
@@ -349,12 +354,18 @@ class DiffusionViT(nn.Module):
                 drop_path=float(dpr[i]),
                 dtype=self.dtype,
                 use_flash=self.use_flash,
-                name=f"blocks_{i}",
             )
-            if return_attention_layer is not None and i == return_attention_layer % self.depth:
+            probe = (return_attention_layer is not None
+                     and i == return_attention_layer % self.depth)
+            if probe:
                 # attention probe (reference Block.return_attention, ViT.py:132-135)
-                return blk(tokens, deterministic=deterministic, return_attention=True)
-            tokens = blk(tokens, deterministic=deterministic)
+                # — forward-only, so remat would be pure overhead: probe through
+                # a plain Block (same name ⇒ same params).
+                return Block(**blk_kwargs, name=f"blocks_{i}")(
+                    tokens, deterministic=deterministic, return_attention=True)
+            # positional deterministic: jax.checkpoint static_argnums covers
+            # positionals only, and Dropout branches on the bool in Python.
+            tokens = block_cls(**blk_kwargs, name=f"blocks_{i}")(tokens, deterministic)
 
         tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
         tokens = nn.Dense(

@@ -46,7 +46,9 @@ def flax_from_torch_state_dict(state_dict: dict, patch_size: int) -> dict:
     p = patch_size
     params: dict[str, Any] = {
         "cls_token": sd["cls_token"],
-        "pos_embed": sd["pos_embed"],
+        # pos_embed is absent for use_sincos_pos models (fixed table, not a
+        # param) — tolerated in both directions.
+        **({"pos_embed": sd["pos_embed"]} if "pos_embed" in sd else {}),
         "time_embed": {"embedding": sd["time_embed.weight"]},
         "norm": {"scale": sd["norm.weight"], "bias": sd["norm.bias"]},
         "head": {"kernel": sd["head.weight"].T, "bias": sd["head.bias"]},
@@ -91,7 +93,7 @@ def torch_state_dict_from_flax(params, patch_size: int) -> dict:
     c = pk.shape[0] // (p * p)
     sd = {
         "cls_token": g("cls_token"),
-        "pos_embed": g("pos_embed"),
+        **({"pos_embed": g("pos_embed")} if "pos_embed" in params else {}),
         "time_embed.weight": g("time_embed", "embedding"),
         "patch_embed.proj.weight": pk.reshape(p, p, c, e).transpose(3, 2, 0, 1),
         "patch_embed.proj.bias": g("patch_embed", "proj", "bias"),

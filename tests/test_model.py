@@ -209,3 +209,33 @@ def test_forward_parity_with_torch_oracle():
         ref = img_t.permute(0, 5, 1, 3, 2, 4).contiguous().view(-1, 3, img, img).numpy()
 
     np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_remat_matches_plain(tiny_model_and_params):
+    """remat=True must be a pure memory/compute trade: identical params,
+    outputs, and gradients (eval and training mode)."""
+    model, params = tiny_model_and_params
+    rmodel = make_model(remat=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
+    t = jnp.array([3, 1500], dtype=jnp.int32)
+
+    rparams = rmodel.init(jax.random.PRNGKey(0), x, t)["params"]
+    assert jax.tree.structure(params) == jax.tree.structure(rparams)
+
+    out = model.apply({"params": params}, x, t)
+    rout = rmodel.apply({"params": params}, x, t)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout), atol=1e-6)
+
+    def loss(m, p):
+        drng = jax.random.PRNGKey(7)
+        y = m.apply({"params": p}, x, t, deterministic=False, rngs={"dropout": drng})
+        return jnp.mean(y**2)
+
+    g = jax.grad(lambda p: loss(model, p))(params)
+    rg = jax.grad(lambda p: loss(rmodel, p))(params)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(rg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # probe path still works under remat
+    attn = rmodel.apply({"params": params}, x, t, return_attention_layer=0)
+    assert attn.shape[0] == 2

@@ -147,6 +147,27 @@ def test_checkpoint_converter_roundtrip():
                  params, back)
 
 
+def test_checkpoint_converter_sincos_roundtrip():
+    """use_sincos_pos models have no pos_embed param; the converter must
+    tolerate its absence in both directions (regression: KeyError on export)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32, depth=1,
+                         num_heads=2, use_sincos_pos=True)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)),
+                        jnp.zeros((1,), jnp.int32))["params"]
+    assert "pos_embed" not in params
+    sd = ckpt.torch_state_dict_from_flax(params, patch_size=8)
+    assert "pos_embed" not in sd
+    back = ckpt.flax_from_torch_state_dict(sd, patch_size=8)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+                 params, back)
+
+
 def test_torch_pkl_file_roundtrip(tmp_path):
     torch = pytest.importorskip("torch")
     import jax
