@@ -32,6 +32,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
 from ddim_cold_tpu.models.init import torch_default_uniform, trunc_normal
 
@@ -121,6 +122,11 @@ class Attention(nn.Module):
     proj_drop: float = 0.0
     dtype: Dtype = jnp.float32
     use_flash: bool = False
+    # sequence parallelism: rotate K/V blocks around `seq_axis` of `seq_mesh`
+    # (parallel/ring_attention.py); `batch_axis` keeps dp sharding composed.
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -142,15 +148,19 @@ class Attention(nn.Module):
         qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, N, H, hd)
 
-        # Pallas fused path: no O(N²) HBM attention matrix. Requires inactive
-        # attention-dropout (the kernel never materializes the weights — with
-        # dropout on, fall back to the einsum path) and no weight probing.
-        flash_ok = (
-            self.use_flash
-            and not need_weights
-            and (deterministic or self.attn_drop == 0.0)
-        )
-        if flash_ok:
+        # Flash/ring paths never materialize the O(N²) weights, so they
+        # require inactive attention-dropout (else fall back to einsum) and
+        # no weight probing.
+        weightless_ok = not need_weights and (deterministic or self.attn_drop == 0.0)
+        if self.seq_mesh is not None and self.seq_axis is not None and weightless_ok:
+            from ddim_cold_tpu.parallel.ring_attention import ring_self_attention
+
+            out = ring_self_attention(
+                q, k, v, self.seq_mesh,
+                axis=self.seq_axis, batch_axis=self.batch_axis, scale=scale,
+            ).astype(self.dtype)
+            attn = None
+        elif self.use_flash and weightless_ok:
             from ddim_cold_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, scale).astype(self.dtype)
@@ -186,6 +196,9 @@ class Block(nn.Module):
     drop_path: float = 0.0
     dtype: Dtype = jnp.float32
     use_flash: bool = False
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, return_attention: bool = False):
@@ -199,6 +212,9 @@ class Block(nn.Module):
             proj_drop=self.drop,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            seq_mesh=self.seq_mesh,
+            seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -289,6 +305,11 @@ class DiffusionViT(nn.Module):
     use_flash: bool = False  # Pallas fused attention (long-seq configs)
     remat: bool = False  # jax.checkpoint each block: recompute activations in
     # backward instead of holding depth× residuals in HBM (big-config training)
+    # sequence parallelism (ring attention over `seq_axis` of `seq_mesh`;
+    # `batch_axis` composes with dp sharding) — sequences beyond one chip
+    seq_mesh: Optional[Mesh] = None
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = None
 
     @property
     def num_patches(self) -> int:
@@ -354,6 +375,9 @@ class DiffusionViT(nn.Module):
                 drop_path=float(dpr[i]),
                 dtype=self.dtype,
                 use_flash=self.use_flash,
+                seq_mesh=self.seq_mesh,
+                seq_axis=self.seq_axis,
+                batch_axis=self.batch_axis,
             )
             probe = (return_attention_layer is not None
                      and i == return_attention_layer % self.depth)

@@ -59,8 +59,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch arrays shard their leading dim over 'data' (DistributedSampler's
-    role, now expressed as a sharding annotation)."""
-    return NamedSharding(mesh, P("data"))
+    role, now expressed as a sharding annotation). Meshes without a 'data'
+    axis (e.g. pure sequence-parallel ``{seq: N}``) replicate the batch."""
+    return NamedSharding(mesh, P("data") if "data" in mesh.shape else P())
 
 
 def shard_batch(batch, mesh: Mesh):

@@ -37,6 +37,7 @@ def ring_attention(
     *,
     axis_name: str,
     scale: float,
+    varying_axes: Optional[tuple[str, ...]] = None,
 ) -> jax.Array:
     """Blockwise-softmax attention with K/V ring rotation.
 
@@ -50,8 +51,9 @@ def ring_attention(
         kv_valid = jnp.ones((B, n_loc), dtype=bool)
 
     # running (output·denominator, denominator, max) accumulators, f32 —
-    # marked varying over the ring axis for shard_map's vma loop typing
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    # marked varying over every axis the inputs vary on (the ring axis, plus
+    # the batch axis on a composed dp×sp mesh) for shard_map's vma loop typing
+    vary = lambda x: jax.lax.pcast(x, varying_axes or (axis_name,), to="varying")
     o = vary(jnp.zeros((B, H, n_loc, D), jnp.float32))
     l = vary(jnp.zeros((B, H, n_loc), jnp.float32))
     m = vary(jnp.full((B, H, n_loc), _NEG_INF, jnp.float32))
@@ -93,13 +95,17 @@ def ring_self_attention(
     mesh: Mesh,
     *,
     axis: str = "data",
+    batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
 ) -> jax.Array:
     """Global-array front end: pads the sequence to the ring size, shards it
     over ``axis``, runs ``ring_attention`` under shard_map, unpads.
 
-    q/k/v are ``(B, N, H, D)`` global arrays (replicated or however placed);
-    the result matches dense softmax attention.
+    q/k/v are ``(B, N, H, D)`` global arrays; the result matches dense softmax
+    attention. On a composed mesh (e.g. ``{'data': 2, 'seq': 4}``) pass
+    ``batch_axis`` so the batch dim stays sharded over data parallelism while
+    the ring rotates over ``axis`` — each (data, seq) device row then holds a
+    (B/dp, N/sp) tile and the ppermute rides only the seq axis.
     """
     B, N, H, D = q.shape
     if scale is None:
@@ -112,11 +118,12 @@ def ring_self_attention(
         pad = [(0, 0), (0, n_pad), (0, 0), (0, 0)]
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
 
-    seq_spec = P(None, axis, None, None)
+    seq_spec = P(batch_axis, axis, None, None)
+    varying = (axis,) + ((batch_axis,) if batch_axis else ())
     fn = shard_map(
-        partial(ring_attention, axis_name=axis, scale=scale),
+        partial(ring_attention, axis_name=axis, scale=scale, varying_axes=varying),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, P(None, axis)),
+        in_specs=(seq_spec, seq_spec, seq_spec, P(batch_axis, axis)),
         out_specs=seq_spec,
     )
     out = fn(q, k, v, valid)

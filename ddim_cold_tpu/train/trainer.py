@@ -69,9 +69,19 @@ def _build_dataset(config: ExperimentConfig, root: str):
     raise ValueError(f"unknown dataset kind {config.dataset!r}")
 
 
-def build_model(config: ExperimentConfig) -> DiffusionViT:
+def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
+    """Model from config. With a mesh carrying a ``seq`` axis, attention runs
+    as ring attention sharded over it (sequence parallelism); attention-
+    dropout is zeroed then — the ring path never materializes the weights, and
+    silently training dense while configured for sp would be worse."""
+    kwargs = dict(config.model_kwargs())
+    if mesh is not None and "seq" in getattr(mesh, "shape", {}):
+        # pure-sp meshes ({seq: N}, no data axis) replicate the batch
+        batch_axis = "data" if "data" in mesh.shape else None
+        kwargs.update(seq_mesh=mesh, seq_axis="seq", batch_axis=batch_axis,
+                      attn_drop_rate=0.0)
     return DiffusionViT(
-        dtype=jnp.bfloat16 if config.amp else jnp.float32, **config.model_kwargs()
+        dtype=jnp.bfloat16 if config.amp else jnp.float32, **kwargs
     )
 
 
@@ -111,7 +121,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     # per-device batch × devices = the global batch fed each step; sharding on
     # the 'data' axis routes each device its slice (replaces DistributedSampler
     # rank interleaving + per-rank DataLoader).
-    data_mesh_size = int(mesh.shape["data"])
+    data_mesh_size = int(mesh.shape.get("data", 1))
     global_batch = config.effective_batch * data_mesh_size
     shard_index, shard_count = jax.process_index(), jax.process_count()
     train_set = _build_dataset(config, config.data_storage[0])
@@ -130,10 +140,15 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         raise ValueError("dataset smaller than one global batch (drop_last)")
 
     # -- model + state -----------------------------------------------------
-    model = build_model(config)
+    model = build_model(config, mesh=mesh)
     rng = jax.random.PRNGKey(config.seed)
-    sample = next(iter(ShardedLoader(train_set, 2, shuffle=False, drop_last=False,
+    # init traces the real step (incl. any ring-attention shard_map), so the
+    # sample's leading dim must divide over the data axis like a real batch
+    sample_n = 2 * data_mesh_size
+    sample = next(iter(ShardedLoader(train_set, sample_n, shuffle=False,
+                                     drop_last=False, pad_final_batch=True,
                                      num_threads=1)))
+    sample = shard_batch(sample, mesh)
     state = create_train_state(
         model, rng, config.lr, train_batches * config.epoch[1], sample
     )
