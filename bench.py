@@ -22,7 +22,9 @@ import time
 BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
 
 
-def main():
+def main(argv=None):
+    """``argv=None`` → sys.argv; scripts (tpu_validate) pass a list to reuse
+    this harness as the single source of timing truth."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI/CPU)")
     ap.add_argument("--steps", type=int, default=100)
@@ -37,7 +39,7 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (env JAX_PLATFORMS can be "
                          "overridden by site config; this flag always wins)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     import jax
 
@@ -116,13 +118,17 @@ def main():
         for k in (5, 20, 50) if args.smoke else (1, 5, 20, 50):
             time_ddim(model, state.params, k, n_sample, "k-sweep")
     if args.northstar:
-        ns_model = DiffusionViT(dtype=jnp.bfloat16,
-                                **MODEL_CONFIGS["oxford_flower_200_p4"])
-        ns_params = ns_model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
         n, k = (4, 100) if args.smoke else (16, 20)
-        time_ddim(ns_model, ns_params, k, n, "north-star 200px")
+        ns_params = None
+        for flash in (False, True):
+            ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
+                                    **MODEL_CONFIGS["oxford_flower_200_p4"])
+            if ns_params is None:
+                ns_params = ns_model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
+            time_ddim(ns_model, ns_params, k, n,
+                      f"north-star 200px flash={int(flash)}")
 
     print(json.dumps({
         "metric": "train_throughput_vit_tiny64_b32",

@@ -23,8 +23,12 @@ New optional keys (defaulted so reference YAMLs run unchanged):
 multi_gpu_trainer.py:5,59), ``seed``, ``honor_diff_step``, ``mesh`` (axis
 sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``), ``use_flash``
 (Pallas fused attention, recommended for the 200px configs),
-``use_sincos_pos`` (fixed sinusoidal positional table, C7) and ``remat``
-(gradient checkpointing per block — HBM for FLOPs on big configs).
+``use_sincos_pos`` (fixed sinusoidal positional table, C7), ``remat``
+(gradient checkpointing per block — HBM for FLOPs on big configs),
+``profile_steps`` (device-trace the first N steps into ``<run_dir>/trace``)
+and ``nan_checks`` (``jax_debug_nans`` for the run). A ``seq`` axis in
+``mesh`` (e.g. ``{data: 4, seq: 2}``) turns on ring-attention sequence
+parallelism (parallel/ring_attention.py).
 """
 
 from __future__ import annotations
@@ -61,6 +65,8 @@ class ExperimentConfig:
     use_flash: bool = False
     use_sincos_pos: bool = False
     remat: bool = False
+    profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
+    nan_checks: bool = False  # jax_debug_nans for the whole run
 
     @property
     def effective_batch(self) -> int:
@@ -138,4 +144,6 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         use_flash=bool(raw.get("use_flash", False)),
         use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
         remat=bool(raw.get("remat", False)),
+        profile_steps=int(raw.get("profile_steps", 0)),
+        nan_checks=bool(raw.get("nan_checks", False)),
     )

@@ -123,10 +123,12 @@ class Attention(nn.Module):
     dtype: Dtype = jnp.float32
     use_flash: bool = False
     # sequence parallelism: rotate K/V blocks around `seq_axis` of `seq_mesh`
-    # (parallel/ring_attention.py); `batch_axis` keeps dp sharding composed.
+    # (parallel/ring_attention.py); `batch_axis` keeps dp sharding composed,
+    # `head_axis` keeps tensor-parallel head sharding effective inside the ring.
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
+    head_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -152,12 +154,22 @@ class Attention(nn.Module):
         # require inactive attention-dropout (else fall back to einsum) and
         # no weight probing.
         weightless_ok = not need_weights and (deterministic or self.attn_drop == 0.0)
-        if self.seq_mesh is not None and self.seq_axis is not None and weightless_ok:
+        seq_parallel = self.seq_mesh is not None and self.seq_axis is not None
+        if seq_parallel and not need_weights and not weightless_ok:
+            # falling back to dense here would silently materialize the full
+            # O(N²) global attention matrix — the exact thing sp exists to
+            # avoid. Configs must zero attn_drop (trainer.build_model does).
+            raise ValueError(
+                "sequence-parallel attention cannot apply attention-dropout "
+                f"(attn_drop={self.attn_drop} active in training); set "
+                "attn_drop_rate=0.0 on the model")
+        if seq_parallel and weightless_ok:
             from ddim_cold_tpu.parallel.ring_attention import ring_self_attention
 
             out = ring_self_attention(
                 q, k, v, self.seq_mesh,
-                axis=self.seq_axis, batch_axis=self.batch_axis, scale=scale,
+                axis=self.seq_axis, batch_axis=self.batch_axis,
+                head_axis=self.head_axis, scale=scale,
             ).astype(self.dtype)
             attn = None
         elif self.use_flash and weightless_ok:
@@ -199,6 +211,7 @@ class Block(nn.Module):
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
+    head_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, return_attention: bool = False):
@@ -215,6 +228,7 @@ class Block(nn.Module):
             seq_mesh=self.seq_mesh,
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
+            head_axis=self.head_axis,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -310,6 +324,7 @@ class DiffusionViT(nn.Module):
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
+    head_axis: Optional[str] = None  # tp axis for head-sharded ring attention
 
     @property
     def num_patches(self) -> int:
@@ -378,6 +393,7 @@ class DiffusionViT(nn.Module):
                 seq_mesh=self.seq_mesh,
                 seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis,
+                head_axis=self.head_axis,
             )
             probe = (return_attention_layer is not None
                      and i == return_attention_layer % self.depth)

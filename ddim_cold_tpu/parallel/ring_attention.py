@@ -96,6 +96,7 @@ def ring_self_attention(
     *,
     axis: str = "data",
     batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
     scale: Optional[float] = None,
 ) -> jax.Array:
     """Global-array front end: pads the sequence to the ring size, shards it
@@ -105,7 +106,11 @@ def ring_self_attention(
     attention. On a composed mesh (e.g. ``{'data': 2, 'seq': 4}``) pass
     ``batch_axis`` so the batch dim stays sharded over data parallelism while
     the ring rotates over ``axis`` — each (data, seq) device row then holds a
-    (B/dp, N/sp) tile and the ppermute rides only the seq axis.
+    (B/dp, N/sp) tile and the ppermute rides only the seq axis. With tensor
+    parallelism too (dp×tp×sp), pass ``head_axis`` so the Megatron-column-
+    split qkv activations keep their heads sharded over tp — softmax is
+    per-head, so each tp group rings only its own heads; without it the specs
+    would force an all-gather and redundant full-head compute.
     """
     B, N, H, D = q.shape
     if scale is None:
@@ -118,8 +123,8 @@ def ring_self_attention(
         pad = [(0, 0), (0, n_pad), (0, 0), (0, 0)]
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
 
-    seq_spec = P(batch_axis, axis, None, None)
-    varying = (axis,) + ((batch_axis,) if batch_axis else ())
+    seq_spec = P(batch_axis, axis, head_axis, None)
+    varying = (axis,) + tuple(a for a in (batch_axis, head_axis) if a)
     fn = shard_map(
         partial(ring_attention, axis_name=axis, scale=scale, varying_axes=varying),
         mesh=mesh,

@@ -40,6 +40,7 @@ from ddim_cold_tpu.parallel import (
 )
 from ddim_cold_tpu.train.step import create_train_state, make_eval_step, make_train_step
 from ddim_cold_tpu.utils import checkpoint as ckpt
+from ddim_cold_tpu.utils import profiling
 from ddim_cold_tpu.utils.logging import ScalarWriter, asctime, print_log
 
 
@@ -76,10 +77,12 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     silently training dense while configured for sp would be worse."""
     kwargs = dict(config.model_kwargs())
     if mesh is not None and "seq" in getattr(mesh, "shape", {}):
-        # pure-sp meshes ({seq: N}, no data axis) replicate the batch
+        # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
+        # tp axis the ring keeps heads sharded over it (no qkv all-gather)
         batch_axis = "data" if "data" in mesh.shape else None
+        head_axis = "model" if int(mesh.shape.get("model", 1)) > 1 else None
         kwargs.update(seq_mesh=mesh, seq_axis="seq", batch_axis=batch_axis,
-                      attn_drop_rate=0.0)
+                      head_axis=head_axis, attn_drop_rate=0.0)
     return DiffusionViT(
         dtype=jnp.bfloat16 if config.amp else jnp.float32, **kwargs
     )
@@ -201,6 +204,14 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
 
+    if config.nan_checks:
+        profiling.enable_nan_checks()
+    # step-bounded device trace (SURVEY.md §5: the reference only had
+    # wall-clock prints); host 0 traces its own devices
+    profiling_until = steps + config.profile_steps if config.profile_steps else 0
+    if profiling_until and jax.process_index() == 0:
+        profiling.start_trace(os.path.join(run_dir, "trace"))
+
     vloss = float("nan")
     loss_rec_dev = jnp.float32(loss_rec)
     time_start = time.time()
@@ -212,6 +223,11 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                 state, shard_batch(batch, mesh), step_rng, loss_rec_dev
             )
             steps += 1
+            if profiling_until and steps >= profiling_until and jax.process_index() == 0:
+                float(loss_rec_dev)  # real D2H drain — block_until_ready can
+                # return early through a remote-TPU tunnel (see bench.py)
+                profiling.stop_trace()
+                profiling_until = 0
             if steps % log_every == 0 and jax.process_index() == 0:
                 loss_rec = float(loss_rec_dev)  # the only per-step host sync
                 time_end = time.time()
@@ -256,6 +272,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         )
         if done:
             break
+    if profiling_until and jax.process_index() == 0:
+        profiling.stop_trace()  # run ended inside the trace window
     writer.close()
     return TrainResult(best_loss=best_loss, last_val_loss=vloss, steps=steps,
                        run_dir=run_dir)

@@ -24,6 +24,16 @@ def trace(log_dir: str):
     return jax.profiler.trace(log_dir)
 
 
+def start_trace(log_dir: str) -> None:
+    """Step-bounded tracing (the trainer's ``profile_steps``): start here,
+    ``stop_trace()`` when the window closes."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
 def annotate(name: str):
     """Named region inside a trace (shows up on the TPU timeline)."""
     return jax.profiler.TraceAnnotation(name)

@@ -124,3 +124,26 @@ def test_seq_parallel_training_end_to_end(tmp_path, synthetic_image_dir):
         )
         result = run(cfg, str(tmp_path), max_steps=2)
         assert np.isfinite(result.best_loss)
+
+
+def test_seq_parallel_head_axis_and_dropout_guard():
+    """tp-composed ring keeps heads sharded (head_axis) and a seq-parallel
+    model with active attention-dropout raises instead of silently densifying."""
+    from ddim_cold_tpu.models import DiffusionViT
+
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+    cfg = dict(img_size=(16, 16), patch_size=4, embed_dim=32, depth=1, num_heads=4)
+    sharded = DiffusionViT(seq_mesh=mesh, seq_axis="seq", batch_axis="data",
+                           head_axis="model", attn_drop_rate=0.0, **cfg)
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 16, 16, 3), jnp.float32)
+    t = jnp.array([1, 2], jnp.int32)
+    params = sharded.init(jax.random.PRNGKey(0), x, t)["params"]
+    plain = DiffusionViT(**cfg)
+    a = np.asarray(plain.apply({"params": params}, x, t))
+    b = np.asarray(sharded.apply({"params": params}, x, t))
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+    bad = DiffusionViT(seq_mesh=mesh, seq_axis="seq", batch_axis="data", **cfg)
+    with pytest.raises(ValueError, match="attention-dropout"):
+        bad.apply({"params": params}, x, t, deterministic=False,
+                  rngs={"dropout": jax.random.PRNGKey(1)})

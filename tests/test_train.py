@@ -205,3 +205,22 @@ def test_smooth_l1_matches_torch():
     want = torch.nn.functional.smooth_l1_loss(torch.from_numpy(a), torch.from_numpy(b)).item()
     got = float(smooth_l1(jnp.asarray(a), jnp.asarray(b)))
     assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_profile_steps_writes_trace(tmp_path, synthetic_image_dir):
+    """profile_steps traces the first N steps into <run_dir>/trace and the
+    run completes normally (reference had only wall-clock prints)."""
+    from ddim_cold_tpu.config import ExperimentConfig
+    from ddim_cold_tpu.train.trainer import run
+
+    cfg = ExperimentConfig(
+        exp_name="prof", framework="trace", batch_size=2, epoch=(0, 1),
+        base_lr=0.005, data_storage=(synthetic_image_dir, synthetic_image_dir),
+        image_size=(16, 16), patch_size=8, embed_dim=32, depth=1, head=2,
+        profile_steps=2,
+    )
+    result = run(cfg, str(tmp_path), max_steps=3)
+    assert np.isfinite(result.best_loss)
+    trace_dir = os.path.join(result.run_dir, "trace")
+    assert os.path.isdir(trace_dir)
+    assert any(f for _, _, fs in os.walk(trace_dir) for f in fs), "empty trace"
