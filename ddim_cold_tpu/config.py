@@ -67,6 +67,7 @@ class ExperimentConfig:
     remat: bool = False
     profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
     nan_checks: bool = False  # jax_debug_nans for the whole run
+    cache_images: object = None  # None=auto (fits 2GB), True/False=force
 
     @property
     def effective_batch(self) -> int:
@@ -146,4 +147,5 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         remat=bool(raw.get("remat", False)),
         profile_steps=int(raw.get("profile_steps", 0)),
         nan_checks=bool(raw.get("nan_checks", False)),
+        cache_images=raw.get("cache_images"),
     )

@@ -16,12 +16,20 @@ must agree on the index→file mapping).
 Per-item randomness (the step t, the Gaussian noise) is drawn from a
 ``seed/epoch/index``-keyed generator so any sample is reproducible — upstream
 leaves this to worker-process global RNG state.
+
+Decoded-image caching: the reference re-decodes every jpg every epoch
+(diffusion_loader.py:47 via DataLoader workers); at TPU step rates the decode
+dominates the epoch. Both datasets therefore cache the decoded+resized base
+image (the deterministic part — corruption stays per-epoch random) in RAM,
+auto-enabled when the whole dataset fits ``CACHE_BUDGET_BYTES`` and
+overridable via ``cache_images``/the YAML ``cache_images`` key.
 """
 
 from __future__ import annotations
 
 import math
 import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -30,6 +38,83 @@ from PIL import Image
 from ddim_cold_tpu.data import native, resize
 
 _IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+#: auto-enable the decoded-image cache while all caching datasets in the
+#: process together fit in this budget (train + val both auto-enable)
+CACHE_BUDGET_BYTES = 2 << 30
+_cache_reserved = 0
+_cache_lock = threading.Lock()
+
+
+class _BaseCache:
+    """Decoded-base-image cache shared by both dataset classes.
+
+    Stores float32 HWC [−1,1] arrays keyed by index. Concurrent ``__getitem__``
+    calls may race on a miss — both decode, one write wins; contents are
+    identical either way (native and PIL paths are bit-exact, tests/test_native).
+    """
+
+    def _init_cache(self, cache_images: Optional[bool], n_items: int,
+                    img_size: Sequence[int]) -> None:
+        global _cache_reserved
+        est = n_items * int(img_size[0]) * int(img_size[1]) * 3 * 4
+        if cache_images is None:
+            # budget is process-wide: train + val datasets both auto-enabling
+            # must together stay under CACHE_BUDGET_BYTES
+            with _cache_lock:
+                cache_images = _cache_reserved + est <= CACHE_BUDGET_BYTES
+                if cache_images:
+                    _cache_reserved += est
+        elif cache_images:
+            with _cache_lock:
+                _cache_reserved += est
+        self.cache_images = bool(cache_images)
+        self._cache_reservation = est if self.cache_images else 0
+        self._cache: dict[int, np.ndarray] = {}
+
+    def __del__(self):
+        res = getattr(self, "_cache_reservation", 0)
+        if res:
+            try:
+                global _cache_reserved
+                with _cache_lock:
+                    _cache_reserved -= res
+            except Exception:  # interpreter teardown: globals may be gone
+                pass
+
+    def _base(self, index: int) -> np.ndarray:
+        """Decoded+resized base image for one item, through the cache."""
+        hit = self._cache.get(index) if self.cache_images else None
+        if hit is not None:
+            return hit
+        img = _load_base(os.path.join(self.root, self.imgList[index]),
+                         self.img_size, self.use_native)
+        if self.cache_images:
+            self._cache[index] = img
+        return img
+
+    def _bases_for(self, indices: Sequence[int], num_threads: int):
+        """Batch path: fill cache misses with one native C++ threaded decode
+        (PIL repair per failed slot), then return the stacked bases — or None
+        when native can't decode the missing files (caller falls back)."""
+        missing = ([i for i in indices if int(i) not in self._cache]
+                   if self.cache_images else list(indices))
+        if missing:
+            paths = [os.path.join(self.root, self.imgList[int(i)]) for i in missing]
+            res = native.base_batch(paths, self.img_size, num_threads=num_threads)
+            if res is None:
+                return None
+            base, failed = res
+            if failed.all():
+                return None
+            for j, i in enumerate(missing):
+                if failed[j]:
+                    base[j] = _load_base(paths[j], self.img_size, use_native=False)
+                if self.cache_images:
+                    self._cache[int(i)] = base[j]
+            if not self.cache_images:
+                return base
+        return np.stack([self._cache[int(i)] for i in indices])
 
 
 def pil_loader(path: str) -> Image.Image:
@@ -65,7 +150,7 @@ def _load_base(path: str, img_size: Sequence[int], use_native: bool = True) -> n
     return img * 2.0 - 1.0
 
 
-class DiffusionDataset:
+class DiffusionDataset(_BaseCache):
     """Gaussian forward-noising dataset (reference diffusion_loader.py:24-58).
 
     ``__getitem__ → (x_t, x_0, t)`` with t ~ U[0, max_step) and
@@ -73,7 +158,8 @@ class DiffusionDataset:
     """
 
     def __init__(self, root: str, imgSize: Sequence[int] = (32, 32), max_step: int = 2000,
-                 seed: int = 0, use_native: bool = True):
+                 seed: int = 0, use_native: bool = True,
+                 cache_images: Optional[bool] = None):
         self.root = root
         self.img_size = tuple(int(s) for s in imgSize)
         self.max_step = max_step
@@ -81,6 +167,7 @@ class DiffusionDataset:
         self.use_native = use_native
         self.epoch = 0
         self.imgList = _list_images(root)
+        self._init_cache(cache_images, len(self.imgList), self.img_size)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -104,30 +191,20 @@ class DiffusionDataset:
         return t, noisy.astype(np.float32)
 
     def __getitem__(self, index: int, t: Optional[int] = None):
-        img = _load_base(os.path.join(self.root, self.imgList[index]),
-                         self.img_size, self.use_native)
+        img = self._base(index)
         t, noisy = self._noise_for(index, img, t)
         return noisy, img.astype(np.float32), t
 
     def get_batch(self, indices: Sequence[int], num_threads: int = 8):
-        """Batch fast path: decode+resize in C++ threads, noise in numpy.
-        Returns collated ``(noisy, target, t)`` arrays, or None to make the
-        loader fall back to per-item assembly."""
+        """Batch fast path: decode+resize in C++ threads (through the cache),
+        noise in numpy. Returns collated ``(noisy, target, t)`` arrays, or
+        None to make the loader fall back to per-item assembly (e.g. a
+        webp/bmp dataset native can't decode)."""
         if not self.use_native:
             return None
-        paths = [os.path.join(self.root, self.imgList[int(i)]) for i in indices]
-        res = native.base_batch(paths, self.img_size, num_threads=num_threads)
-        if res is None:
+        base = self._bases_for(indices, num_threads)
+        if base is None:
             return None
-        base, failed = res
-        if failed.all():
-            # native can't decode any of this batch (e.g. a webp/bmp dataset) —
-            # let the loader's parallel per-item path handle it instead of
-            # repairing the whole batch sequentially here.
-            return None
-        for j, i in enumerate(indices):
-            if failed[j]:
-                base[j] = _load_base(paths[j], self.img_size, use_native=False)
         noisy = np.empty_like(base)
         ts = np.empty(len(base), np.int32)
         for j, i in enumerate(indices):
@@ -138,7 +215,7 @@ class DiffusionDataset:
         return len(self.imgList)
 
 
-class ColdDownSampleDataset:
+class ColdDownSampleDataset(_BaseCache):
     """Cold (downsampling) degradation dataset (reference diffusion_loader.py:60-138).
 
     ``target_mode``:
@@ -153,7 +230,8 @@ class ColdDownSampleDataset:
     """
 
     def __init__(self, root: str, imgSize: Sequence[int] = (32, 32),
-                 target_mode: str = "chain", seed: int = 0, use_native: bool = True):
+                 target_mode: str = "chain", seed: int = 0, use_native: bool = True,
+                 cache_images: Optional[bool] = None):
         if imgSize[0] != imgSize[1]:
             raise ValueError("downsample dataset requires square images")
         if target_mode not in ("chain", "direct"):
@@ -167,6 +245,7 @@ class ColdDownSampleDataset:
         self.use_native = use_native
         self.epoch = 0
         self.imgList = _list_images(root)
+        self._init_cache(cache_images, len(self.imgList), self.img_size)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -181,10 +260,20 @@ class ColdDownSampleDataset:
         )
         return int(rng.integers(self.max_step)) + 1  # t ∈ [1, max_step]
 
+    def _degrade_pair(self, img: np.ndarray, t: int):
+        """(D(x,t), target) from a decoded base image (numpy nearest-resize)."""
+        noisy_t = self.get_t(img, 2**t)
+        target = self.get_t(img, 2 ** (t - 1)) if self.target_mode == "chain" else img
+        return noisy_t.astype(np.float32), target.astype(np.float32)
+
     def __getitem__(self, index: int, t: Optional[int] = None):
         path = os.path.join(self.root, self.imgList[index])
         if t is None:
             t = self._draw_t(index)
+        if self.cache_images:
+            # cached base + numpy degrade (degrade is cheap; decode was the cost)
+            noisy, target = self._degrade_pair(self._base(index), t)
+            return noisy, target, t
         if self.use_native:
             # full item (decode → resize → degrade) in one C++ call
             res = native.cold_item(path, self.size, t, self.target_mode == "chain")
@@ -194,12 +283,25 @@ class ColdDownSampleDataset:
 
     def get_batch(self, indices: Sequence[int], num_threads: int = 8):
         """Batch fast path: the whole (decode, resize, degrade, collate)
-        pipeline in C++ threads; failed slots redone via PIL with the same t.
-        Returns ``(noisy, target, t)`` or None (→ loader per-item path)."""
+        pipeline in C++ threads (decode through the cache when enabled);
+        failed slots redone via PIL with the same t. Returns
+        ``(noisy, target, t)`` or None (→ loader per-item path)."""
         if not self.use_native:
             return None
-        paths = [os.path.join(self.root, self.imgList[int(i)]) for i in indices]
         ts = [self._draw_t(int(i)) for i in indices]
+        if self.cache_images:
+            base = self._bases_for(indices, num_threads)
+            if base is None:
+                return None
+            pair = native.cold_pair_batch(base, ts, self.target_mode == "chain",
+                                          num_threads=num_threads)
+            if pair is not None:
+                return pair[0], pair[1], np.asarray(ts, np.int32)
+            pairs = [self._degrade_pair(base[j], ts[j]) for j in range(len(ts))]
+            return (np.stack([p[0] for p in pairs]),
+                    np.stack([p[1] for p in pairs]),
+                    np.asarray(ts, np.int32))
+        paths = [os.path.join(self.root, self.imgList[int(i)]) for i in indices]
         res = native.cold_batch(paths, ts, self.size, self.target_mode == "chain",
                                 num_threads=num_threads)
         if res is None:

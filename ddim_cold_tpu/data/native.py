@@ -68,9 +68,15 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("DDIM_COLD_NO_NATIVE"):
             _lib_failed = True
             return None
-        if not os.path.isfile(_SO_PATH) and not _build():
-            _lib_failed = True
-            return None
+        src = os.path.join(_NATIVE_DIR, "ddim_data.cc")
+        stale = (os.path.isfile(_SO_PATH) and os.path.isfile(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH))
+        if (not os.path.isfile(_SO_PATH) or stale) and not _build():
+            # a stale-but-present .so still loads (new entry points are
+            # hasattr-guarded); only a missing library is fatal here
+            if not os.path.isfile(_SO_PATH):
+                _lib_failed = True
+                return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
@@ -93,6 +99,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ddim_base_batch.argtypes = [charpp, ctypes.c_int, ctypes.c_int,
                                         ctypes.c_int, ctypes.c_int, f32p, i32p]
         lib.ddim_base_batch.restype = ctypes.c_int
+        try:
+            lib.ddim_cold_pair_batch.argtypes = [f32p, i32p, ctypes.c_int,
+                                                 ctypes.c_int, ctypes.c_int,
+                                                 ctypes.c_int, f32p, f32p]
+            lib.ddim_cold_pair_batch.restype = None
+        except AttributeError:  # stale .so from before this entry point
+            pass
         _lib = lib
         return _lib
 
@@ -175,6 +188,26 @@ def cold_batch(paths: Sequence[str], ts: Sequence[int], size: int, chain: bool,
         failed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return noisy, target, failed.astype(bool)
+
+
+def cold_pair_batch(bases: np.ndarray, ts: Sequence[int], chain: bool,
+                    num_threads: int = 8):
+    """(D(x,t), target) pairs from already-decoded (n, S, S, 3) base images —
+    the cache's warm-epoch path (no file IO, degrade in C++ threads). Returns
+    ``(noisy, target)`` or None when the library (or entry point) is missing."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ddim_cold_pair_batch"):
+        return None
+    bases = np.ascontiguousarray(bases, np.float32)
+    n, size = bases.shape[0], bases.shape[1]
+    noisy = np.empty_like(bases)
+    target = np.empty_like(bases)
+    ts_arr = np.asarray(ts, np.int32)
+    lib.ddim_cold_pair_batch(
+        _f32(bases), ts_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, size, int(chain), int(num_threads), _f32(noisy), _f32(target),
+    )
+    return noisy, target
 
 
 def base_batch(paths: Sequence[str], out_hw: tuple[int, int], num_threads: int = 8):

@@ -61,12 +61,16 @@ def _fully_addressable(tree) -> bool:
 
 
 def _build_dataset(config: ExperimentConfig, root: str):
+    cache = config.cache_images
     if config.dataset == "cold":
-        return ColdDownSampleDataset(root, imgSize=config.image_size, target_mode="chain")
+        return ColdDownSampleDataset(root, imgSize=config.image_size,
+                                     target_mode="chain", cache_images=cache)
     if config.dataset == "cold_direct":
-        return ColdDownSampleDataset(root, imgSize=config.image_size, target_mode="direct")
+        return ColdDownSampleDataset(root, imgSize=config.image_size,
+                                     target_mode="direct", cache_images=cache)
     if config.dataset == "gaussian":
-        return DiffusionDataset(root, imgSize=config.image_size, max_step=config.total_steps)
+        return DiffusionDataset(root, imgSize=config.image_size,
+                                max_step=config.total_steps, cache_images=cache)
     raise ValueError(f"unknown dataset kind {config.dataset!r}")
 
 

@@ -335,6 +335,25 @@ int ddim_cold_batch(const char** paths, const int32_t* ts, int n, int size,
   });
 }
 
+// Batch of cold pairs computed from ALREADY-DECODED base images (the
+// decoded-image cache's warm-epoch path): bases is (n, size, size, 3) float32
+// in [−1,1]; writes (D(x,t), D(x,t−1) | x₀) into the output buffers.
+void ddim_cold_pair_batch(const float* bases, const int32_t* ts, int n,
+                          int size, int chain, int num_threads, float* noisy,
+                          float* target) {
+  const size_t stride = static_cast<size_t>(size) * size * 3;
+  parallel_items(n, num_threads, [&](int i) -> int {
+    const float* base = bases + stride * i;
+    cold_degrade_impl(base, size, 3, 1 << ts[i], noisy + stride * i);
+    if (chain) {
+      cold_degrade_impl(base, size, 3, 1 << (ts[i] - 1), target + stride * i);
+    } else {
+      std::memcpy(target + stride * i, base, sizeof(float) * stride);
+    }
+    return 0;
+  });
+}
+
 // Batch of decoded+resized base images ([−1,1]) — the shared front half of
 // the Gaussian dataset (noise stays in numpy for Philox-stream parity).
 int ddim_base_batch(const char** paths, int n, int out_h, int out_w,

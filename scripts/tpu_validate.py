@@ -12,7 +12,6 @@ Usage: python scripts/tpu_validate.py [--quick]
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -73,6 +72,18 @@ def main():
                                         k=20, n=16))
     assert np.isfinite(h).all() and 0.0 <= h.min() and h.max() <= 1.0
     print("[sample] vit_tiny k=20 N=16: finite, in [0,1] OK")
+    if not args.quick:
+        # the 20-step bf16 sampler accumulation at 200px, both attention paths
+        # (bench only times these — numerics are asserted here)
+        for flash in (False, True):
+            m2 = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
+                              **MODEL_CONFIGS["oxford_flower_200_p4"])
+            p2 = m2.init(jax.random.PRNGKey(0), jnp.zeros((1, 200, 200, 3)),
+                         jnp.zeros((1,), jnp.int32))["params"]
+            h = np.asarray(sampling.ddim_sample(m2, p2, jax.random.PRNGKey(2),
+                                                k=100, n=4))
+            assert np.isfinite(h).all() and 0.0 <= h.min() and h.max() <= 1.0
+            print(f"[sample] 200px k=100 N=4 flash={flash}: finite, in [0,1] OK")
 
     # -- 3. timing: delegate to bench.py (single source of timing truth) ---
     import bench

@@ -252,3 +252,44 @@ def test_loader_threaded_matches_sync(synthetic_image_dir):
     for (x1, y1, t1), (x2, y2, t2) in zip(a, b):
         np.testing.assert_array_equal(x1, x2)
         np.testing.assert_array_equal(t1, t2)
+
+
+def test_cache_matches_uncached(synthetic_image_dir):
+    """Decoded-image cache changes nothing observable: per-item and batch
+    outputs are identical with cache on/off, for both dataset families."""
+    from ddim_cold_tpu.data import ColdDownSampleDataset, DiffusionDataset
+
+    for cls, kw in ((ColdDownSampleDataset, {}),
+                    (ColdDownSampleDataset, {"target_mode": "direct"}),
+                    (DiffusionDataset, {"max_step": 100})):
+        cold = cls(synthetic_image_dir, imgSize=[32, 32], cache_images=False, **kw)
+        hot = cls(synthetic_image_dir, imgSize=[32, 32], cache_images=True, **kw)
+        for i in range(4):
+            a, b = cold[i], hot[i]
+            np.testing.assert_array_equal(a[0], b[0])
+            np.testing.assert_array_equal(a[1], b[1])
+            assert a[2] == b[2]
+        # second pass hits the now-warm cache
+        for i in range(4):
+            a, b = cold[i], hot[i]
+            np.testing.assert_array_equal(a[1], b[1])
+        ga = cold.get_batch(np.arange(6), num_threads=2)
+        gb = hot.get_batch(np.arange(6), num_threads=2)
+        if ga is not None and gb is not None:
+            for x, y in zip(ga, gb):
+                np.testing.assert_array_equal(x, y)
+
+
+def test_cache_auto_threshold(synthetic_image_dir):
+    from ddim_cold_tpu.data import ColdDownSampleDataset
+    from ddim_cold_tpu.data import datasets as dsmod
+
+    small = ColdDownSampleDataset(synthetic_image_dir, imgSize=[32, 32])
+    assert small.cache_images  # 10 × 32×32×3×4 ≪ budget
+    old = dsmod.CACHE_BUDGET_BYTES
+    try:
+        dsmod.CACHE_BUDGET_BYTES = 10
+        big = ColdDownSampleDataset(synthetic_image_dir, imgSize=[32, 32])
+        assert not big.cache_images
+    finally:
+        dsmod.CACHE_BUDGET_BYTES = old

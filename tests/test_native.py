@@ -139,3 +139,21 @@ def test_env_kill_switch(monkeypatch, synthetic_image_dir):
     assert ds.get_batch([0, 1]) is None  # → loader per-item path
     monkeypatch.setattr(native, "_lib", None)
     monkeypatch.setattr(native, "_lib_failed", False)
+
+
+@pytest.mark.parametrize("mode", ["chain", "direct"])
+def test_cold_pair_batch_parity(rng, mode):
+    """Warm-cache C++ degrade path == numpy degrade, bit for bit."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    bases = rng.randn(5, 64, 64, 3).astype(np.float32)
+    ts = [1, 3, 6, 2, 4]
+    pair = native.cold_pair_batch(bases, ts, chain=(mode == "chain"))
+    if pair is None:
+        pytest.skip("stale .so without ddim_cold_pair_batch")
+    noisy, target = pair
+    for j, t in enumerate(ts):
+        np.testing.assert_array_equal(noisy[j], resize.cold_degrade(bases[j], 2**t, 64))
+        want_t = (resize.cold_degrade(bases[j], 2 ** (t - 1), 64)
+                  if mode == "chain" else bases[j])
+        np.testing.assert_array_equal(target[j], want_t)
