@@ -28,7 +28,9 @@ sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``), ``use_flash``
 ``profile_steps`` (device-trace the first N steps into ``<run_dir>/trace``)
 and ``nan_checks`` (``jax_debug_nans`` for the run). A ``seq`` axis in
 ``mesh`` (e.g. ``{data: 4, seq: 2}``) turns on ring-attention sequence
-parallelism (parallel/ring_attention.py).
+parallelism (parallel/ring_attention.py); a ``pipe`` axis (with optional
+``microbatches``) turns on GPipe pipeline parallelism over the stacked
+``scan_blocks`` layout (parallel/pipeline.py).
 """
 
 from __future__ import annotations
@@ -68,6 +70,8 @@ class ExperimentConfig:
     profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
     nan_checks: bool = False  # jax_debug_nans for the whole run
     cache_images: object = None  # None=auto (fits 2GB), True/False=force
+    scan_blocks: bool = False  # nn.scan over depth (stacked params)
+    microbatches: Optional[int] = None  # pipeline microbatches (default 2·pipe)
 
     @property
     def effective_batch(self) -> int:
@@ -112,6 +116,7 @@ class ExperimentConfig:
             use_flash=self.use_flash,
             use_sincos_pos=self.use_sincos_pos,
             remat=self.remat,
+            scan_blocks=self.scan_blocks,
         )
 
 
@@ -148,4 +153,6 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         profile_steps=int(raw.get("profile_steps", 0)),
         nan_checks=bool(raw.get("nan_checks", False)),
         cache_images=raw.get("cache_images"),
+        scan_blocks=bool(raw.get("scan_blocks", False)),
+        microbatches=(int(raw["microbatches"]) if "microbatches" in raw else None),
     )

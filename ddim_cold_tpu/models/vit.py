@@ -214,7 +214,9 @@ class Block(nn.Module):
     head_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True, return_attention: bool = False):
+    def __call__(self, x: jax.Array, deterministic: bool = True,
+                 return_attention: bool = False,
+                 dp_rate: Optional[jax.Array] = None):
         ln = lambda name: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name=name)
         y, attn = Attention(
             dim=self.dim,
@@ -237,8 +239,20 @@ class Block(nn.Module):
 
         # per-sample stochastic depth (reference ViT.py:52-71): Bernoulli(keep)
         # mask broadcast over all but the batch dim, survivors scaled 1/keep —
-        # exactly nn.Dropout with broadcast_dims.
-        residual = nn.Dropout(self.drop_path, broadcast_dims=(1, 2), deterministic=deterministic)
+        # exactly nn.Dropout with broadcast_dims. Under nn.scan the rate
+        # arrives as a traced per-block scalar (``dp_rate``) — no Python
+        # branching on it allowed, so the mask is drawn explicitly.
+        if dp_rate is None:
+            residual = nn.Dropout(self.drop_path, broadcast_dims=(1, 2),
+                                  deterministic=deterministic)
+        elif deterministic:
+            residual = lambda y: y
+        else:
+            def residual(y, _rate=dp_rate):
+                keep = 1.0 - _rate
+                mask = jax.random.bernoulli(
+                    self.make_rng("dropout"), keep, (y.shape[0], 1, 1))
+                return jnp.where(mask, y / keep, jnp.zeros_like(y)).astype(y.dtype)
 
         x = x + residual(y)
         y = Mlp(
@@ -250,6 +264,33 @@ class Block(nn.Module):
         )(ln("norm2")(x), deterministic=deterministic)
         x = x + residual(y)
         return x
+
+
+def block_template(model: "DiffusionViT") -> "Block":
+    """Unbound single-layer Block matching ``model``'s scan_blocks layout —
+    the pipeline executor (parallel/pipeline.py) applies it functionally per
+    stage layer with slices of the stacked ``blocks`` params (drop-path rate
+    arrives traced). Module-level fn: constructing a child inside an unbound
+    module method trips flax's parent tracking."""
+    return Block(
+        dim=model.embed_dim, num_heads=model.num_heads, mlp_ratio=model.mlp_ratio,
+        qkv_bias=model.qkv_bias, qk_scale=model.qk_scale, drop=model.drop_rate,
+        attn_drop=model.attn_drop_rate, drop_path=0.0, dtype=model.dtype,
+        use_flash=model.use_flash,
+    )
+
+
+class _ScanShell(nn.Module):
+    """Scan-compatible adapter around Block: ``(carry, (det, dp_rate)) →
+    (carry, None)``. ``nn.scan`` over this stacks every block's params on a
+    leading depth axis — one compiled block regardless of depth, and the
+    substrate pipeline parallelism shards stages from."""
+
+    blk: "Block"
+
+    @nn.compact
+    def __call__(self, x, deterministic, dp_rate):
+        return self.blk(x, deterministic, dp_rate=dp_rate), None
 
 
 class PatchEmbed(nn.Module):
@@ -325,10 +366,13 @@ class DiffusionViT(nn.Module):
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None  # tp axis for head-sharded ring attention
+    scan_blocks: bool = False  # nn.scan over depth: params stacked on a
+    # leading layer axis (O(1) compile in depth; pipeline-parallel substrate)
 
     @property
     def num_patches(self) -> int:
         return (self.img_size[0] // self.patch_size) * (self.img_size[1] // self.patch_size)
+
 
     @nn.compact
     def __call__(
@@ -337,10 +381,30 @@ class DiffusionViT(nn.Module):
         t: jax.Array,
         deterministic: bool = True,
         return_attention_layer: Optional[int] = None,
+        stage: str = "full",
+        tokens: Optional[jax.Array] = None,
     ) -> jax.Array:
+        """``stage`` partitions the forward for pipeline parallelism
+        (parallel/pipeline.py): ``"embed"`` returns the token sequence after
+        patch/pos/time embedding; ``"head"`` takes ``tokens`` (the trunk
+        output, supplied by the pipeline) and runs final-LN → head →
+        un-patchify; ``"full"`` is the normal forward."""
         B = x.shape[0]
         E = self.embed_dim
         N = self.num_patches
+
+        if stage == "head":
+            if tokens is None:
+                raise ValueError('stage="head" requires tokens')
+            tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
+            tokens = nn.Dense(
+                self.in_chans * self.patch_size**2,
+                dtype=self.dtype,
+                kernel_init=trunc_normal(std=0.02),
+                bias_init=nn.initializers.zeros_init(),
+                name="head",
+            )(tokens)
+            return self.unpatchify(tokens[:, 1:, :]).astype(jnp.float32)
 
         x = x.astype(self.dtype)
         tokens = PatchEmbed(
@@ -372,40 +436,70 @@ class DiffusionViT(nn.Module):
             pos_embed = self.param("pos_embed", trunc_normal(std=0.02), (1, N + 1, E))
         tokens = tokens + pos_embed.astype(self.dtype) + time_embed
         tokens = nn.Dropout(self.drop_rate, deterministic=deterministic, name="pos_drop")(tokens)
+        if stage == "embed":
+            return tokens
 
         # stochastic depth decay rule: linspace(0, rate, depth) (ViT.py:176)
         dpr = np.linspace(0.0, self.drop_path_rate, self.depth)
-        # deterministic (argnum 2; 0 is the module) is a Python bool steering
-        # trace-time structure — it must stay static under jax.checkpoint.
-        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
-        for i in range(self.depth):
-            blk_kwargs = dict(
-                dim=E,
-                num_heads=self.num_heads,
-                mlp_ratio=self.mlp_ratio,
-                qkv_bias=self.qkv_bias,
-                qk_scale=self.qk_scale,
-                drop=self.drop_rate,
-                attn_drop=self.attn_drop_rate,
-                drop_path=float(dpr[i]),
-                dtype=self.dtype,
-                use_flash=self.use_flash,
-                seq_mesh=self.seq_mesh,
-                seq_axis=self.seq_axis,
-                batch_axis=self.batch_axis,
-                head_axis=self.head_axis,
+        if self.scan_blocks:
+            if return_attention_layer is not None:
+                raise ValueError("attention probe requires scan_blocks=False")
+            blk = Block(
+                dim=E, num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                qkv_bias=self.qkv_bias, qk_scale=self.qk_scale,
+                drop=self.drop_rate, attn_drop=self.attn_drop_rate,
+                drop_path=0.0,  # rate arrives traced per layer (dp_rate)
+                dtype=self.dtype, use_flash=self.use_flash,
+                seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
+                batch_axis=self.batch_axis, head_axis=self.head_axis,
+                # the shell's field module binds to THIS scope, not the
+                # shell's — name it so params land under "blocks"
+                name="blocks",
             )
-            probe = (return_attention_layer is not None
-                     and i == return_attention_layer % self.depth)
-            if probe:
-                # attention probe (reference Block.return_attention, ViT.py:132-135)
-                # — forward-only, so remat would be pure overhead: probe through
-                # a plain Block (same name ⇒ same params).
-                return Block(**blk_kwargs, name=f"blocks_{i}")(
-                    tokens, deterministic=deterministic, return_attention=True)
-            # positional deterministic: jax.checkpoint static_argnums covers
-            # positionals only, and Dropout branches on the bool in Python.
-            tokens = block_cls(**blk_kwargs, name=f"blocks_{i}")(tokens, deterministic)
+            shell = _ScanShell if not self.remat else nn.remat(
+                _ScanShell, static_argnums=(2,))
+            scan = nn.scan(
+                shell,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, 0),
+                length=self.depth,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )(blk)
+            tokens, _ = scan(tokens, deterministic,
+                             jnp.asarray(dpr, jnp.float32))
+        else:
+            # deterministic (argnum 2; 0 is the module) is a Python bool
+            # steering trace-time structure — static under jax.checkpoint.
+            block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+            for i in range(self.depth):
+                blk_kwargs = dict(
+                    dim=E,
+                    num_heads=self.num_heads,
+                    mlp_ratio=self.mlp_ratio,
+                    qkv_bias=self.qkv_bias,
+                    qk_scale=self.qk_scale,
+                    drop=self.drop_rate,
+                    attn_drop=self.attn_drop_rate,
+                    drop_path=float(dpr[i]),
+                    dtype=self.dtype,
+                    use_flash=self.use_flash,
+                    seq_mesh=self.seq_mesh,
+                    seq_axis=self.seq_axis,
+                    batch_axis=self.batch_axis,
+                    head_axis=self.head_axis,
+                )
+                probe = (return_attention_layer is not None
+                         and i == return_attention_layer % self.depth)
+                if probe:
+                    # attention probe (reference Block.return_attention,
+                    # ViT.py:132-135) — forward-only, so remat would be pure
+                    # overhead: probe a plain Block (same name ⇒ same params).
+                    return Block(**blk_kwargs, name=f"blocks_{i}")(
+                        tokens, deterministic=deterministic, return_attention=True)
+                # positional deterministic: jax.checkpoint static_argnums
+                # covers positionals only; Dropout branches on it in Python.
+                tokens = block_cls(**blk_kwargs, name=f"blocks_{i}")(tokens, deterministic)
 
         tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
         tokens = nn.Dense(

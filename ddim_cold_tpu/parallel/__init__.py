@@ -6,7 +6,8 @@ from ddim_cold_tpu.parallel.mesh import (
     shard_params,
     shard_train_state,
 )
-from ddim_cold_tpu.parallel.sharding import param_partition_specs
+from ddim_cold_tpu.parallel.pipeline import make_pipelined_apply, pipeline_blocks
+from ddim_cold_tpu.parallel.sharding import param_partition_specs, pipeline_param_specs
 
 __all__ = [
     "make_mesh",
@@ -16,4 +17,7 @@ __all__ = [
     "shard_params",
     "shard_train_state",
     "param_partition_specs",
+    "pipeline_param_specs",
+    "make_pipelined_apply",
+    "pipeline_blocks",
 ]

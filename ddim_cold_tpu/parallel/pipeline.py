@@ -1,0 +1,179 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+The reference has no model parallelism of any kind (SURVEY.md C17:
+"TP/PP/SP/EP/CP: ABSENT"); like tensor (sharding.py) and sequence
+(ring_attention.py) parallelism, this is a TPU-native beyond-parity
+capability: depth is sharded over the ``pipe`` mesh axis (each device owns
+``depth / n_stages`` consecutive transformer blocks, stacked scan_blocks
+layout), the batch is split into microbatches, and activations flow stage to
+stage over ICI via ``ppermute`` while every stage computes a different
+microbatch — the classic (M + S − 1)-step schedule with S−1 bubble steps.
+
+Everything runs under one ``shard_map``: per step every device applies its
+stage (a ``lax.scan`` over its local blocks) to its current microbatch and
+rotates the result to its successor. The step loop is itself a ``lax.scan``,
+so reverse-mode AD yields the reverse pipeline schedule for free (ppermute
+transposes to the inverted permutation); stage parameters enter as sharded
+operands, so their gradients come back sharded the same way — the optimizer
+update stays local to each stage's device row.
+
+Composes with data parallelism (batch dim stays sharded over ``data``).
+Tensor/sequence axes cannot be combined with ``pipe`` (the stage body is
+manual over the whole mesh); the trainer enforces that.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def pipeline_blocks(
+    block,
+    stacked_params,
+    dpr: jax.Array,
+    tokens: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "pipe",
+    batch_axis: Optional[str] = "data",
+    n_microbatch: int = 2,
+    deterministic: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Run the transformer trunk through the pipeline.
+
+    ``block`` — unbound Block template (model.block_template());
+    ``stacked_params`` — the scan_blocks ``params["blocks"]`` subtree, leaves
+    leading dim = depth; ``dpr`` — (depth,) stochastic-depth rates;
+    ``tokens`` — (B, N, C) trunk input. Requires depth % n_stages == 0 and
+    B % n_microbatch == 0 (per data shard).
+    """
+    n_stages = int(mesh.shape[axis])
+    depth = int(jax.tree.leaves(stacked_params)[0].shape[0])
+    if depth % n_stages != 0:
+        raise ValueError(f"depth {depth} not divisible by {n_stages} pipeline stages")
+    bps = depth // n_stages
+    B = tokens.shape[0]
+    M = int(n_microbatch)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if batch_axis is not None and batch_axis not in mesh.shape:
+        batch_axis = None
+
+    # (depth, ...) → (S, bps, ...): stage-major so P(axis) shards stages
+    stage_params = jax.tree.map(
+        lambda a: a.reshape((n_stages, bps) + a.shape[1:]), stacked_params)
+    dpr_st = jnp.asarray(dpr, jnp.float32).reshape(n_stages, bps)
+    mb = tokens.reshape((M, B // M) + tokens.shape[1:])
+
+    use_rng = dropout_rng is not None
+    varying = (axis,) + ((batch_axis,) if batch_axis else ())
+
+    def per_device(params_s, dpr_s, mb_all, rng):
+        params_s = jax.tree.map(lambda a: a[0], params_s)  # local (bps, ...)
+        dpr_s = dpr_s[0]
+        s = jax.lax.axis_index(axis)
+
+        d = (jax.lax.axis_index(batch_axis) if (use_rng and batch_axis is not None)
+             else 0)
+        n_data = int(mesh.shape.get(batch_axis, 1)) if batch_axis is not None else 1
+
+        def stage_apply(tok, step_i):
+            """One stage = scan over its bps local blocks."""
+            def body(tok, xs):
+                p, rate, j = xs
+                rngs = None
+                if use_rng:
+                    # distinct key per (data shard, schedule step, global
+                    # layer): step_i identifies the microbatch flowing
+                    # through, s*bps+j the layer, d the data row — without d
+                    # every dp shard would draw identical dropout masks.
+                    key = jax.random.fold_in(
+                        rng[0], (step_i * depth + s * bps + j) * n_data + d)
+                    rngs = {"dropout": key}
+                tok = block.apply({"params": p}, tok, deterministic,
+                                  dp_rate=rate, rngs=rngs)
+                return tok, None
+
+            tok, _ = jax.lax.scan(body, tok, (params_s, dpr_s, jnp.arange(bps)))
+            return tok
+
+        T = M + n_stages - 1
+        # accumulators must be typed varying over the pipe axis too (values
+        # differ per stage via params/ppermute) for shard_map's vma loop
+        # typing; zeros_like already inherits the data-varying from mb_all
+        vary = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+        out_buf = vary(jnp.zeros_like(mb_all))
+        buf = vary(jnp.zeros_like(mb_all[0]))
+
+        def step(carry, i):
+            buf, out_buf = carry
+            # stage 0 injects microbatch i; later stages consume the ring buffer
+            inject = mb_all[jnp.clip(i, 0, M - 1)]
+            cur = jnp.where(s == 0, inject, buf)
+            y = stage_apply(cur, i)
+            # bubble steps (this stage has no live microbatch) pass input
+            # through unchanged — keeps values bounded, result is discarded
+            active = (i - s >= 0) & (i - s < M)
+            y = jnp.where(active, y, cur)
+            # last stage banks its finished microbatch
+            out_idx = i - (n_stages - 1)
+            collect = (s == n_stages - 1) & (out_idx >= 0) & (out_idx < M)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                out_buf, y, jnp.clip(out_idx, 0, M - 1), 0)
+            out_buf = jnp.where(collect, banked, out_buf)
+            perm = [(d, (d + 1) % n_stages) for d in range(n_stages)]
+            buf = jax.lax.ppermute(y, axis, perm)
+            return (buf, out_buf), None
+
+        (buf, out_buf), _ = jax.lax.scan(step, (buf, out_buf), jnp.arange(T))
+        # replicate the last stage's outputs to every stage (zeros elsewhere)
+        out = jnp.where(s == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
+        return jax.lax.psum(out, axis)
+
+    tok_spec = P(None, batch_axis, None, None)
+    rng_arg = (dropout_rng if use_rng else jax.random.PRNGKey(0))[None]
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), tok_spec, P()),
+        out_specs=tok_spec,
+    )
+    out = fn(stage_params, dpr_st, mb, rng_arg)
+    return out.reshape(tokens.shape)
+
+
+def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
+                         batch_axis: Optional[str] = "data",
+                         n_microbatch: int = 2):
+    """An ``apply_fn`` drop-in for ``model.apply`` that routes the block trunk
+    through the pipeline: embed (replicated, cheap) → pipelined blocks →
+    head. ``model`` must be built with ``scan_blocks=True``."""
+    if not model.scan_blocks:
+        raise ValueError("pipelined apply requires scan_blocks=True")
+    from ddim_cold_tpu.models.vit import block_template
+
+    block = block_template(model)
+    dpr = np.linspace(0.0, model.drop_path_rate, model.depth)
+
+    def apply_fn(variables, x, t, deterministic: bool = True, rngs=None):
+        params = variables["params"]
+        dropout_rng = (rngs or {}).get("dropout")
+        tokens = model.apply({"params": params}, x, t, stage="embed",
+                             deterministic=deterministic, rngs=rngs)
+        tokens = pipeline_blocks(
+            block, params["blocks"], dpr, tokens, mesh,
+            axis=axis, batch_axis=batch_axis, n_microbatch=n_microbatch,
+            deterministic=deterministic, dropout_rng=dropout_rng,
+        )
+        return model.apply({"params": params}, x, t, stage="head",
+                           tokens=tokens, deterministic=deterministic, rngs=rngs)
+
+    return apply_fn

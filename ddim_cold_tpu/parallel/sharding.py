@@ -33,12 +33,31 @@ def _spec_for(path: tuple[str, ...]) -> P:
     leaf = names[-1]
     module = names[-2] if len(names) >= 2 else ""
     if module in _COL_KERNELS:
-        return P(None, "model") if leaf == "kernel" else P("model")
-    if module in _ROW_KERNELS:
-        return P("model", None) if leaf == "kernel" else P()
-    return P()
+        spec = P(None, "model") if leaf == "kernel" else P("model")
+    elif module in _ROW_KERNELS:
+        spec = P("model", None) if leaf == "kernel" else P()
+    else:
+        return P()
+    if names[0] == "blocks":
+        # stacked scan_blocks layout: an extra leading layer axis shifts
+        # every dim right by one
+        return P(None, *spec)
+    return spec
 
 
 def param_partition_specs(params):
-    """PyTree of PartitionSpecs matching ``params``' structure."""
+    """PyTree of PartitionSpecs matching ``params``' structure (both the
+    unrolled ``blocks_{i}`` and stacked ``blocks`` layouts)."""
     return jax.tree_util.tree_map_with_path(lambda path, _: _spec_for(path), params)
+
+
+def pipeline_param_specs(params, axis: str = "pipe"):
+    """Specs for pipeline parallelism: the stacked ``blocks`` subtree shards
+    its leading layer axis over ``axis`` (each stage's device row owns its
+    own blocks — grads and optimizer state stay stage-local); everything
+    outside the trunk (embeddings, norm, head) is replicated."""
+    def spec(path, _):
+        names = [getattr(k, "key", str(k)) for k in path]
+        return P(axis) if names and names[0] == "blocks" else P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -49,14 +49,18 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
     )
 
 
-def make_train_step(model) -> Callable:
+def make_train_step(model, apply_fn: Callable = None) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
     device scalar so the host only syncs at log points — the reference's
     per-step ``loss.item()`` would serialize the TPU pipeline. State buffers
     are donated (in-place update, no double-buffered params in HBM).
+
+    ``apply_fn`` overrides ``model.apply`` with the same signature — the hook
+    pipeline parallelism uses (parallel.pipeline.make_pipelined_apply).
     """
+    apply_fn = apply_fn or model.apply
 
     @partial(jax.jit, donate_argnums=(0, 3))
     def train_step(state: train_state.TrainState, batch, rng: jax.Array,
@@ -65,7 +69,7 @@ def make_train_step(model) -> Callable:
         dropout_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
-            pred = model.apply(
+            pred = apply_fn(
                 {"params": params}, noisy, t, deterministic=False,
                 rngs={"dropout": dropout_rng},
             )
@@ -77,11 +81,13 @@ def make_train_step(model) -> Callable:
     return train_step
 
 
-def make_eval_step(model) -> Callable:
+def make_eval_step(model, apply_fn: Callable = None) -> Callable:
+    apply_fn = apply_fn or model.apply
+
     @jax.jit
     def eval_step(params, batch):
         noisy, target, t = batch
-        pred = model.apply({"params": params}, noisy, t, deterministic=True)
+        pred = apply_fn({"params": params}, noisy, t, deterministic=True)
         return smooth_l1(pred, target)
 
     return eval_step

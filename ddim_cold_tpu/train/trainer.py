@@ -34,7 +34,9 @@ from ddim_cold_tpu.data import ColdDownSampleDataset, DiffusionDataset, ShardedL
 from ddim_cold_tpu.models import DiffusionViT
 from ddim_cold_tpu.parallel import (
     make_mesh,
+    make_pipelined_apply,
     param_partition_specs,
+    pipeline_param_specs,
     shard_batch,
     shard_train_state,
 )
@@ -78,13 +80,21 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     """Model from config. With a mesh carrying a ``seq`` axis, attention runs
     as ring attention sharded over it (sequence parallelism); attention-
     dropout is zeroed then — the ring path never materializes the weights, and
-    silently training dense while configured for sp would be worse."""
+    silently training dense while configured for sp would be worse. A ``pipe``
+    axis forces the stacked scan_blocks layout (the pipeline's substrate)."""
     kwargs = dict(config.model_kwargs())
-    if mesh is not None and "seq" in getattr(mesh, "shape", {}):
+    mesh_shape = getattr(mesh, "shape", {}) if mesh is not None else {}
+    if "pipe" in mesh_shape:
+        if "model" in mesh_shape or "seq" in mesh_shape:
+            raise ValueError(
+                "pipeline parallelism composes with data parallelism only — "
+                f"drop 'model'/'seq' from mesh {dict(mesh_shape)}")
+        kwargs["scan_blocks"] = True
+    if "seq" in mesh_shape:
         # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
         # tp axis the ring keeps heads sharded over it (no qkv all-gather)
-        batch_axis = "data" if "data" in mesh.shape else None
-        head_axis = "model" if int(mesh.shape.get("model", 1)) > 1 else None
+        batch_axis = "data" if "data" in mesh_shape else None
+        head_axis = "model" if int(mesh_shape.get("model", 1)) > 1 else None
         kwargs.update(seq_mesh=mesh, seq_axis="seq", batch_axis=batch_axis,
                       head_axis=head_axis, attn_drop_rate=0.0)
     return DiffusionViT(
@@ -128,8 +138,19 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     # per-device batch × devices = the global batch fed each step; sharding on
     # the 'data' axis routes each device its slice (replaces DistributedSampler
     # rank interleaving + per-rank DataLoader).
+    # build the model first: it validates mesh-axis composition (pipe vs
+    # model/seq) before any batch-arithmetic error can mask that message
+    model = build_model(config, mesh=mesh)
     data_mesh_size = int(mesh.shape.get("data", 1))
     global_batch = config.effective_batch * data_mesh_size
+    pipe_stages = int(mesh.shape.get("pipe", 1))
+    n_micro = (config.microbatches or 2 * pipe_stages) if pipe_stages > 1 else 1
+    if pipe_stages > 1 and (
+        global_batch % n_micro or (global_batch // n_micro) % data_mesh_size
+    ):
+        raise ValueError(
+            f"pipeline needs global batch {global_batch} divisible by "
+            f"microbatches {n_micro} and each microbatch by data={data_mesh_size}")
     shard_index, shard_count = jax.process_index(), jax.process_count()
     train_set = _build_dataset(config, config.data_storage[0])
     test_set = _build_dataset(config, config.data_storage[1])
@@ -146,8 +167,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     if train_batches == 0:
         raise ValueError("dataset smaller than one global batch (drop_last)")
 
-    # -- model + state -----------------------------------------------------
-    model = build_model(config, mesh=mesh)
+    # -- model state -------------------------------------------------------
     rng = jax.random.PRNGKey(config.seed)
     # init traces the real step (incl. any ring-attention shard_map), so the
     # sample's leading dim must divide over the data axis like a real batch
@@ -198,13 +218,20 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         print_log("TrainSet batchs:" + str(train_batches), log)
         print_log("TestSet batchs:" + str(test_batches), log)
 
-    # tensor-parallel param specs when the config asks for a 'model' axis;
-    # pure-dp stays replicated (gradient psum implicit in jit either way).
-    specs = (param_partition_specs(state.params)
-             if int(mesh.shape.get("model", 1)) > 1 else None)
+    # parallelism-dependent param layout: pipeline shards the stacked blocks
+    # over 'pipe'; tensor parallelism shards Megatron column/row kernels over
+    # 'model'; pure-dp stays replicated (gradient psum implicit in jit).
+    apply_fn = None
+    if pipe_stages > 1:
+        specs = pipeline_param_specs(state.params)
+        apply_fn = make_pipelined_apply(model, mesh, n_microbatch=n_micro)
+    elif int(mesh.shape.get("model", 1)) > 1:
+        specs = param_partition_specs(state.params)
+    else:
+        specs = None
     state = shard_train_state(state, mesh, specs)
-    train_step = make_train_step(model)
-    eval_step = make_eval_step(model)
+    train_step = make_train_step(model, apply_fn)
+    eval_step = make_eval_step(model, apply_fn)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
 

@@ -84,8 +84,40 @@ def flax_from_torch_state_dict(state_dict: dict, patch_size: int) -> dict:
     return params
 
 
+def stack_block_params(params: dict) -> dict:
+    """Unrolled ``blocks_0..blocks_{d-1}`` subtrees → one ``blocks`` subtree
+    with a leading layer axis (the ``scan_blocks=True`` model's layout)."""
+    depth = 0
+    while f"blocks_{depth}" in params:
+        depth += 1
+    if depth == 0:
+        return dict(params)
+    out = {k: v for k, v in params.items() if not re.match(r"^blocks_\d+$", k)}
+    out["blocks"] = jax.tree.map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+        *(params[f"blocks_{i}"] for i in range(depth)),
+    )
+    return out
+
+
+def unstack_block_params(params: dict) -> dict:
+    """Inverse of ``stack_block_params``: split the stacked ``blocks`` subtree
+    back into per-layer ``blocks_{i}`` trees."""
+    if "blocks" not in params:
+        return dict(params)
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    stacked = params["blocks"]
+    depth = jax.tree.leaves(stacked)[0].shape[0]
+    for i in range(depth):
+        out[f"blocks_{i}"] = jax.tree.map(lambda a, _i=i: np.asarray(a[_i]), stacked)
+    return out
+
+
 def torch_state_dict_from_flax(params, patch_size: int) -> dict:
-    """Inverse of ``flax_from_torch_state_dict`` (numpy arrays, torch-key names)."""
+    """Inverse of ``flax_from_torch_state_dict`` (numpy arrays, torch-key
+    names). Accepts both block layouts — a stacked ``blocks`` subtree
+    (scan_blocks models) is unstacked first."""
+    params = unstack_block_params(params)
     g = lambda *ks: np.asarray(_dig(params, ks))
     p = patch_size
     pk = g("patch_embed", "proj", "kernel")  # (p²C, E)

@@ -239,3 +239,44 @@ def test_remat_matches_plain(tiny_model_and_params):
     # probe path still works under remat
     attn = rmodel.apply({"params": params}, x, t, return_attention_layer=0)
     assert attn.shape[0] == 2
+
+
+def test_scan_blocks_matches_unrolled(tiny_model_and_params):
+    """scan_blocks=True is a layout change only: unrolled params stacked into
+    the scanned layout produce identical eval outputs, and the converter
+    round-trips both layouts."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    model, params = tiny_model_and_params
+    smodel = make_model(scan_blocks=True)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16, 3))
+    t = jnp.array([7, 1200], dtype=jnp.int32)
+
+    stacked = ckpt.stack_block_params(params)
+    sparams = smodel.init(jax.random.PRNGKey(0), x, t)["params"]
+    assert jax.tree.structure(jax.tree.map(lambda a: a.shape, stacked)) \
+        == jax.tree.structure(jax.tree.map(lambda a: a.shape, sparams))
+
+    a = np.asarray(model.apply({"params": params}, x, t))
+    b = np.asarray(smodel.apply({"params": stacked}, x, t))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+    # unstack inverts stack exactly
+    back = ckpt.unstack_block_params(stacked)
+    jax.tree.map(lambda u, v: np.testing.assert_array_equal(np.asarray(u), np.asarray(v)),
+                 params, back)
+
+    # torch export is layout-independent
+    sd_a = ckpt.torch_state_dict_from_flax(params, patch_size=8)
+    sd_b = ckpt.torch_state_dict_from_flax(stacked, patch_size=8)
+    assert sd_a.keys() == sd_b.keys()
+    for k in sd_a:
+        np.testing.assert_array_equal(sd_a[k], sd_b[k])
+
+    # training mode runs finite with split per-layer dropout rngs
+    y = smodel.apply({"params": stacked}, x, t, deterministic=False,
+                     rngs={"dropout": jax.random.PRNGKey(9)})
+    assert bool(jnp.isfinite(y).all())
+
+    with pytest.raises(ValueError, match="probe"):
+        smodel.apply({"params": stacked}, x, t, return_attention_layer=0)
