@@ -46,6 +46,7 @@ def pipeline_blocks(
     n_microbatch: int = 2,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
+    remat: bool = False,
 ) -> jax.Array:
     """Run the transformer trunk through the pipeline.
 
@@ -74,7 +75,13 @@ def pipeline_blocks(
     mb = tokens.reshape((M, B // M) + tokens.shape[1:])
 
     use_rng = dropout_rng is not None
-    varying = (axis,) + ((batch_axis,) if batch_axis else ())
+
+    def apply_block(p, tok, rate, rngs):
+        return block.apply({"params": p}, tok, deterministic,
+                           dp_rate=rate, rngs=rngs)
+
+    if remat:
+        apply_block = jax.checkpoint(apply_block)
 
     def per_device(params_s, dpr_s, mb_all, rng):
         params_s = jax.tree.map(lambda a: a[0], params_s)  # local (bps, ...)
@@ -98,8 +105,7 @@ def pipeline_blocks(
                     key = jax.random.fold_in(
                         rng[0], (step_i * depth + s * bps + j) * n_data + d)
                     rngs = {"dropout": key}
-                tok = block.apply({"params": p}, tok, deterministic,
-                                  dp_rate=rate, rngs=rngs)
+                tok = apply_block(p, tok, rate, rngs)
                 return tok, None
 
             tok, _ = jax.lax.scan(body, tok, (params_s, dpr_s, jnp.arange(bps)))
@@ -158,6 +164,12 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
     head. ``model`` must be built with ``scan_blocks=True``."""
     if not model.scan_blocks:
         raise ValueError("pipelined apply requires scan_blocks=True")
+    if model.seq_axis is not None or model.head_axis is not None:
+        # the stage body applies a plain dense block template — ring attention
+        # / tp head sharding configured on the model would silently vanish
+        raise ValueError(
+            "pipeline parallelism composes with data parallelism only; "
+            "model has seq_axis/head_axis set")
     from ddim_cold_tpu.models.vit import block_template
 
     block = block_template(model)
@@ -172,6 +184,7 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
             block, params["blocks"], dpr, tokens, mesh,
             axis=axis, batch_axis=batch_axis, n_microbatch=n_microbatch,
             deterministic=deterministic, dropout_rng=dropout_rng,
+            remat=model.remat,
         )
         return model.apply({"params": params}, x, t, stage="head",
                            tokens=tokens, deterministic=deterministic, rngs=rngs)

@@ -17,7 +17,7 @@ function serves 1 chip or a full slice.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +49,7 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
     )
 
 
-def make_train_step(model, apply_fn: Callable = None) -> Callable:
+def make_train_step(model, apply_fn: Optional[Callable] = None) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
@@ -81,7 +81,7 @@ def make_train_step(model, apply_fn: Callable = None) -> Callable:
     return train_step
 
 
-def make_eval_step(model, apply_fn: Callable = None) -> Callable:
+def make_eval_step(model, apply_fn: Optional[Callable] = None) -> Callable:
     apply_fn = apply_fn or model.apply
 
     @jax.jit

@@ -272,11 +272,14 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         loss_rec = float(loss_rec_dev)
 
         # -- evaluate: global-mean loss per batch, mean over batches --------
+        # losses stay on device so dispatch pipelines across the val set; the
+        # single float() below is the only host sync (the reference's
+        # loss.item()-per-batch pattern would idle the TPU between batches)
         test_loader.set_epoch(epoch)
         batch_losses = [
-            float(eval_step(state.params, shard_batch(b, mesh))) for b in test_loader
+            eval_step(state.params, shard_batch(b, mesh)) for b in test_loader
         ]
-        vloss = float(np.mean(batch_losses))
+        vloss = float(jnp.mean(jnp.stack(batch_losses)))
 
         if jax.process_index() == 0:
             print_log(f"epoch: {epoch:4d}    loss: {vloss:.5f}    time:{asctime()}", log)
