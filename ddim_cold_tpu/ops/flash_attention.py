@@ -3,15 +3,25 @@
 The reference's attention is three separate cuDNN GEMMs with an O(N²) f32
 attention matrix materialized in HBM (ViT.py:110-114). Here the whole
 ``softmax(q·kᵀ·scale)·v`` is one Pallas kernel: a grid over (batch·heads,
-query blocks) where each program streams its K/V through VMEM, so the logits
-never round-trip to HBM. For the in-repo configs (N ≤ 2501: the 200px/p4
-model) K/V for one head fit VMEM whole, giving a single-pass masked softmax
-per query block — the MXU sees two back-to-back GEMMs.
+query blocks, K/V blocks) where each program streams one K/V chunk through
+VMEM and folds it into a running (max, denominator, accumulator) triple —
+the classic flash-attention online softmax. VMEM usage is bounded by the
+block sizes, not the sequence length, so the kernel scales past the in-repo
+worst case (N=2501, the 200px/p4 model) to genuinely long sequences; the
+logits never round-trip to HBM and the MXU sees two GEMMs per chunk.
+
+The K/V grid axis is innermost: TPU grids execute sequentially, so the VMEM
+scratch accumulators carry across the chunks of one (head, q-block) and are
+re-initialized when the chunk index wraps to 0.
 
 Autodiff: forward is the kernel; backward is a custom VJP that recomputes the
-attention matrix with plain XLA einsums (flash-style recompute — O(N²) HBM
-only under ``grad``, which the training path only hits with dropout disabled;
-with attention dropout active the model falls back to the einsum path anyway).
+attention matrix with plain XLA einsums (flash-style recompute). The
+recompute bound: backward materializes the O(N²) probability matrix in HBM —
+fine through N≈8k on a 16GB chip (N=8192, B·H=48 ⇒ ~12GB transient at f32,
+XLA usually fuses it smaller); past that, shard the sequence instead (ring
+attention, parallel/ring_attention.py, whose backward is blocked by
+construction). The training path only hits this VJP with attention dropout
+disabled — with dropout active the model falls back to the einsum path anyway.
 
 On non-TPU backends the kernel runs in interpreter mode, so tests exercise the
 identical code path on CPU.
@@ -24,24 +34,50 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
 
 
-def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, n_valid: int):
-    """One (head, query-block) program: out = softmax(mask(q·kᵀ))·v in f32."""
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      scale: float, n_valid: int, block_kv: int, n_kv: int):
+    """One (head, q-block, kv-block) program: fold this K/V chunk into the
+    running softmax state; emit o = acc/l on the last chunk."""
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     q = q_ref[0].astype(jnp.float32)  # (bq, D)
-    k = k_ref[0].astype(jnp.float32)  # (N, D)
+    k = k_ref[0].astype(jnp.float32)  # (bkv, D)
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (bq, N)
-    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    ) * scale  # (bq, bkv)
+    col = kv_i * block_kv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
     logits = jnp.where(col < n_valid, logits, _NEG_INF)
-    m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
-    out = jnp.dot(p, v_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32)
-    o_ref[0] = (out / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+    # online softmax update (the same math the ring-attention steps use,
+    # parallel/ring_attention.py:62-71, here per VMEM chunk)
+    m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)  # (bq, 1) replicated
+    l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(logits - m_new)  # (bq, bkv)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.dot(p, v_ref[0].astype(jnp.float32),
+                 preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _emit():
+        l = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -53,24 +89,27 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     scale: float,
     block_q: int = 256,
+    block_kv: int = 512,
 ) -> jax.Array:
     """Fused non-causal multi-head attention.
 
     q/k/v: ``(B, N, H, D)`` (the model's head layout, ViT.py:104-107);
     returns ``(B, N, H, D)`` in q's dtype. Softmax runs in float32 regardless
     of input dtype, matching the einsum path bit-for-bit up to GEMM precision.
+    VMEM per program ≈ (block_q + 2·block_kv)·D_padded input tiles plus the
+    f32 accumulator — independent of N.
     """
-    return _flash_forward(q, k, v, scale, block_q)
+    return _flash_forward(q, k, v, scale, block_q, block_kv)
 
 
-def _flash_forward(q, k, v, scale, block_q):
+def _flash_forward(q, k, v, scale, block_q, block_kv):
     # Interpreter mode exists so CPU tests exercise the kernel path; on any
     # other non-TPU backend (e.g. GPU) interpreting would be a silent
     # orders-of-magnitude slowdown — use the dense einsum instead.
@@ -90,20 +129,32 @@ def _flash_forward(q, k, v, scale, block_q):
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     BH, Np, Dp = qh.shape
     bq = min(block_q, Np)
+    bkv = min(block_kv, Np)
     qh = _pad_to(qh, 1, bq)
-    grid = (BH, qh.shape[1] // bq)
+    kh, vh = _pad_to(kh, 1, bkv), _pad_to(vh, 1, bkv)
+    n_kv = kh.shape[1] // bkv
+    grid = (BH, qh.shape[1] // bq, n_kv)
 
-    kernel = functools.partial(_attention_kernel, scale=scale, n_valid=N)
+    kernel = functools.partial(_attention_kernel, scale=scale, n_valid=N,
+                               block_kv=bkv, n_kv=n_kv)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Np, Dp), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Np, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
+            pltpu.VMEM((bq, _LANE), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((bq, _LANE), jnp.float32),  # running denominator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=backend == "cpu",
     )(qh, kh, vh)
 
@@ -120,11 +171,11 @@ def _dense_attention_f32(q, k, v, scale):
     return p, jnp.einsum("bhnm,bmhd->bnhd", p, v.astype(jnp.float32))
 
 
-def _flash_fwd(q, k, v, scale, block_q):
-    return _flash_forward(q, k, v, scale, block_q), (q, k, v)
+def _flash_fwd(q, k, v, scale, block_q, block_kv):
+    return _flash_forward(q, k, v, scale, block_q, block_kv), (q, k, v)
 
 
-def _flash_bwd(scale, block_q, residuals, g):
+def _flash_bwd(scale, block_q, block_kv, residuals, g):
     q, k, v = residuals
     p, _ = _dense_attention_f32(q, k, v, scale)  # recompute (flash-style)
     gf = g.astype(jnp.float32)

@@ -205,12 +205,30 @@ def save_checkpoint(path: str, tree) -> None:
 
 
 def restore_checkpoint(path: str, target=None):
-    """Restore a pytree checkpoint; ``target`` fixes structure/dtypes."""
+    """Restore a pytree checkpoint; ``target`` fixes structure/dtypes.
+
+    numpy targets restore as host arrays regardless of the topology that
+    saved them (a checkpoint written by an N-process run names devices a
+    different world doesn't have — the restore args below override those
+    saved shardings); jax.Array targets restore sharded per their sharding.
+    """
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()
     if target is None:
         return ckptr.restore(os.path.abspath(path))
+    item = _to_host(target)
+
+    def restore_arg(x):
+        if isinstance(x, jax.Array):  # non-addressable multi-host leaf
+            return ocp.ArrayRestoreArgs(sharding=x.sharding,
+                                        global_shape=x.shape, dtype=x.dtype)
+        if isinstance(x, np.ndarray):
+            return ocp.RestoreArgs(restore_type=np.ndarray, dtype=x.dtype)
+        return ocp.RestoreArgs()
+
     return ckptr.restore(
-        os.path.abspath(path), args=ocp.args.PyTreeRestore(item=_to_host(target))
+        os.path.abspath(path),
+        args=ocp.args.PyTreeRestore(
+            item=item, restore_args=jax.tree.map(restore_arg, item)),
     )

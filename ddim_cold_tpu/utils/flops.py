@@ -1,0 +1,67 @@
+"""Analytic FLOP accounting for the DiffusionViT — the MFU denominator.
+
+The reference never measures utilization (its only perf record is wall-clock
+``time_cost`` lines, multi_gpu_trainer.py:135-138); to say how far a step is
+from the chip's ceiling we count the model's matmul FLOPs analytically and
+divide by (peak · step_time). Elementwise/softmax/LN work is ignored — on TPU
+those ride the VPU and are fused into the GEMM pipeline; standard MFU practice
+counts MXU FLOPs only.
+
+Peak numbers are per-chip bf16 dense (not sparse) from published TPU specs,
+keyed by ``jax.devices()[0].device_kind`` so the bench JSON can name the
+hardware it ran on (BENCH vs_baseline is otherwise cross-hardware
+apples-to-oranges — VERDICT round 1).
+"""
+
+from __future__ import annotations
+
+#: bf16 dense peak TFLOP/s per chip by jax device_kind (prefix-matched).
+PEAK_BF16_TFLOPS = {
+    "TPU v6": 918.0,  # Trillium
+    "TPU v5p": 459.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5": 459.0,
+    "TPU v4 lite": 138.0,  # v4i
+    "TPU v4": 275.0,
+    "TPU v3": 123.0,
+    "TPU v2": 46.0,
+}
+
+
+def peak_tflops(device_kind: str) -> float | None:
+    """Longest-prefix match of the device kind; None when unknown (CPU etc.)."""
+    best = None
+    for kind, peak in PEAK_BF16_TFLOPS.items():
+        if device_kind.startswith(kind) and (best is None or len(kind) > best[0]):
+            best = (len(kind), peak)
+    return best[1] if best else None
+
+
+def vit_forward_flops(*, img_size=(64, 64), patch_size=8, embed_dim=384,
+                      depth=7, num_heads=12, mlp_ratio=1.0, in_chans=3) -> float:
+    """Matmul FLOPs (2·MACs) for one image's forward pass.
+
+    Per block (dim D, tokens N): qkv 3·N·D², attn scores+values 2·N²·D,
+    proj N·D², MLP 2·N·D²·mlp_ratio. Plus patch-embed N·P²·C·D in and the
+    head's N·D·P²·C out (ViT.py:158-218 structure).
+    """
+    H, W = img_size
+    n = (H // patch_size) * (W // patch_size) + 1  # +1 cls token
+    d = embed_dim
+    per_block = 3 * n * d * d + 2 * n * n * d + n * d * d + 2 * n * d * d * mlp_ratio
+    patch = n * (patch_size * patch_size * in_chans) * d  # embed + head are
+    return 2.0 * (depth * per_block + 2 * patch)          # the same GEMM shape
+
+
+def train_step_flops(batch: int, **model_kwargs) -> float:
+    """fwd + bwd ≈ 3× forward (grads w.r.t. inputs and weights each cost one
+    forward's worth of matmuls)."""
+    return 3.0 * batch * vit_forward_flops(**model_kwargs)
+
+
+def mfu(flops_per_step: float, step_seconds: float, device_kind: str,
+        n_devices: int = 1) -> float | None:
+    peak = peak_tflops(device_kind)
+    if peak is None or step_seconds <= 0:
+        return None
+    return flops_per_step / (step_seconds * peak * 1e12 * n_devices)

@@ -1,0 +1,30 @@
+"""Make the ``JAX_PLATFORMS`` env var authoritative for our entry points.
+
+Some deployments (e.g. the axon TPU-tunnel image this framework is benched
+on) inject a site hook that pins ``jax_platforms`` programmatically, which
+silently overrides the env var — a user running ``JAX_PLATFORMS=cpu python
+multi_gpu_trainer.py …`` would still dial the TPU. Every CLI in this repo
+calls :func:`honor_env_platform` before its first device query so the env var
+behaves the way the JAX docs say it does.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_env_platform() -> None:
+    """Re-apply ``JAX_PLATFORMS`` over any site-config pin.
+
+    No-op when the env var is unset or the configured first-choice platform
+    already matches (so the site's own ``axon,cpu`` fallback list survives a
+    redundant ``JAX_PLATFORMS=axon``)."""
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not want:
+        return
+    import jax
+
+    current = jax.config.jax_platforms or ""
+    if current.split(",")[0].strip() == want.split(",")[0].strip():
+        return
+    jax.config.update("jax_platforms", want)

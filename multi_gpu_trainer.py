@@ -42,6 +42,9 @@ def main(argv, base_dir=None):
     shutil.copy(yaml_path, run_dir)
 
     from ddim_cold_tpu.train.trainer import run
+    from ddim_cold_tpu.utils.platform import honor_env_platform
+
+    honor_env_platform()  # JAX_PLATFORMS env must beat any site-config pin
 
     result = run(config, base)
     print(f"\nbest val loss {result.best_loss:.5f} after {result.steps} steps "

@@ -1,0 +1,80 @@
+"""Worker for test_multihost.py — one simulated host in a 2-process run.
+
+Run as: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <out_dir>
+
+Each process gets 4 virtual CPU devices (xla_force_host_platform_device_count,
+set by the parent), initializes `jax.distributed` over the local coordinator
+(the DCN-rendezvous path, parallel/mesh.py:28-36), builds an 8-device global
+mesh, feeds its process-local half of the global batch through
+``shard_batch`` (make_array_from_process_local_data — the multi-host branch,
+parallel/mesh.py:74-77), runs one train step, and participates in a
+collective orbax save (train/trainer.py save path). Writes the loss it saw to
+``<out_dir>/loss_<proc_id>.txt`` for the parent to compare.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    coordinator, num_procs, proc_id, out_dir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+    import jax
+
+    # the site jax config can override the JAX_PLATFORMS env var (it does on
+    # the axon bench host) — force the virtual-CPU platform programmatically,
+    # exactly like tests/conftest.py
+    jax.config.update("jax_platforms", "cpu")
+
+    from ddim_cold_tpu.parallel.mesh import (
+        initialize_distributed, make_mesh, shard_batch,
+    )
+
+    initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    mesh = make_mesh({"data": jax.device_count()})
+
+    model = DiffusionViT(img_size=(8, 8), patch_size=4, embed_dim=16,
+                         depth=1, num_heads=2, total_steps=10)
+    # deterministic per-process shard of a notional global batch of 16:
+    # process r holds rows [r*8, r*8+8) — identical data either way the
+    # global array is assembled, so the loss must agree across processes.
+    rng = np.random.RandomState(0)
+    gx = rng.randn(16, 8, 8, 3).astype(np.float32)
+    gy = rng.randn(16, 8, 8, 3).astype(np.float32)
+    gt = rng.randint(1, 4, size=(16,)).astype(np.int32)
+    lo, hi = proc_id * 8, proc_id * 8 + 8
+    local = (gx[lo:hi], gy[lo:hi], gt[lo:hi])
+
+    batch = shard_batch(local, mesh)
+    assert not batch[0].is_fully_addressable  # genuinely multi-host global
+
+    state = create_train_state(model, jax.random.PRNGKey(0), lr=1e-3,
+                               total_steps=10, sample_batch=local)
+    train_step = make_train_step(model)
+    state, loss, _ = train_step(state, batch, jax.random.PRNGKey(1),
+                                jnp.float32(5.0))
+    loss = float(loss)  # global-mean loss: identical on both processes
+
+    # collective orbax save: every process calls save (trainer.py:284-287)
+    ckpt.save_checkpoint(os.path.join(out_dir, "ckpt"), state.params)
+
+    with open(os.path.join(out_dir, f"loss_{proc_id}.txt"), "w") as f:
+        f.write(repr(loss))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

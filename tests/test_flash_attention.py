@@ -34,6 +34,29 @@ def test_flash_block_q_smaller_than_seq():
     np.testing.assert_allclose(ours, np.asarray(want), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("N,bq,bkv", [(300, 64, 128), (257, 32, 64)])
+def test_flash_blocked_kv_matches_dense(N, bq, bkv):
+    """K/V streamed in chunks (n_kv > 1): the online-softmax accumulation
+    across kv blocks must match the dense softmax, including the masked
+    padded tail of the last chunk."""
+    q, k, v = _rand_qkv(4, 2, N, 2, 16)
+    scale = 16**-0.5
+    ours = np.asarray(flash_attention(q, k, v, scale, bq, bkv))
+    _, want = _dense_attention_f32(q, k, v, scale)
+    np.testing.assert_allclose(ours, np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
+def test_flash_long_sequence_bounded_vmem():
+    """N well past the in-repo maximum (2501): the kernel's VMEM need is set
+    by (block_q, block_kv), not N — this shape would not fit a single-pass
+    K/V-resident kernel's VMEM on real hardware."""
+    q, k, v = _rand_qkv(5, 1, 4096, 1, 8)
+    scale = 8**-0.5
+    ours = np.asarray(flash_attention(q, k, v, scale, 512, 512))
+    _, want = _dense_attention_f32(q, k, v, scale)
+    np.testing.assert_allclose(ours, np.asarray(want), rtol=2e-5, atol=2e-6)
+
+
 def test_flash_bf16_inputs():
     q, k, v = _rand_qkv(2, 1, 64, 2, 8)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))

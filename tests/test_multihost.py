@@ -1,0 +1,80 @@
+"""Multi-host (DCN) path: 2 real processes × 4 virtual CPU devices.
+
+The reference is single-node only (hardcoded localhost NCCL rendezvous,
+multi_gpu_trainer.py:28); this build claims multi-host via
+``jax.distributed`` + per-process data shards (SURVEY.md §1 target layering).
+Round 1 never exercised that branch — this test spawns two OS processes that
+rendezvous over a local coordinator, assemble a global batch with
+``make_array_from_process_local_data``, take one identical training step, and
+perform a collective orbax save (tests/_multihost_worker.py)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_train_step(tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_ENABLE_X64="0",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(r), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    losses = []
+    for r in range(2):
+        with open(tmp_path / f"loss_{r}.txt") as f:
+            losses.append(float(f.read()))
+    # the gradient psum makes the loss a global mean — identical across hosts
+    assert losses[0] == losses[1]
+    assert 0.0 < losses[0] < 10.0
+    # the collective orbax save produced one complete checkpoint, readable
+    # by a plain single-process consumer (restore needs a target tree: the
+    # saved shardings name devices from the 2-process world)
+    assert (tmp_path / "ckpt").is_dir()
+    import jax
+    import numpy as np
+
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.utils.checkpoint import restore_checkpoint
+
+    model = DiffusionViT(img_size=(8, 8), patch_size=4, embed_dim=16,
+                         depth=1, num_heads=2, total_steps=10)
+    template = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, 8, 3), np.float32),
+        np.zeros((1,), np.int32))["params"]
+    params = restore_checkpoint(str(tmp_path / "ckpt"), template)
+    # structure preserved; values finite and post-step (≠ the shared init)
+    assert jax.tree.structure(params) == jax.tree.structure(template)
+    leaves, init_leaves = jax.tree.leaves(params), jax.tree.leaves(template)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(leaves, init_leaves))
