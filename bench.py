@@ -123,7 +123,7 @@ def main(argv=None):
         f"({img_per_sec:.0f} img/s, mfu={train_mfu if train_mfu is None else round(train_mfu, 4)})")
 
     # --------------------------------------------------------- batch scaling
-    if not (args.skip_scaling or args.smoke):
+    if not args.skip_scaling:
         rows = []
         for b in (64, 128, 256):
             bt = synth_batch(b)
@@ -193,7 +193,7 @@ def main(argv=None):
 
     # ------------------------------------------------- e2e with the data path
     if not args.skip_e2e:
-        e2e = _bench_e2e(args, model, state, train_step, log)
+        e2e = _bench_e2e(args, state, train_step, log)
         sub.update(e2e)
 
     print(json.dumps({
@@ -212,7 +212,7 @@ def main(argv=None):
     }))
 
 
-def _bench_e2e(args, model, state, train_step, log):
+def _bench_e2e(args, state, train_step, log):
     """Steps/s with ShardedLoader + the C++ pipeline feeding from disk —
     the number comparable to the reference's DataLoader-inclusive 702 img/s.
     Uses ./OxfordFlowers/train when present (the committed make_dataset

@@ -1,4 +1,4 @@
-"""Fused softmax-attention Pallas kernel for TPU (the long-sequence hot op).
+"""Fused softmax-attention Pallas kernels for TPU (the long-sequence hot op).
 
 The reference's attention is three separate cuDNN GEMMs with an O(N²) f32
 attention matrix materialized in HBM (ViT.py:110-114). Here the whole
@@ -14,17 +14,16 @@ The K/V grid axis is innermost: TPU grids execute sequentially, so the VMEM
 scratch accumulators carry across the chunks of one (head, q-block) and are
 re-initialized when the chunk index wraps to 0.
 
-Autodiff: forward is the kernel; backward is a custom VJP that recomputes the
-attention matrix with plain XLA einsums (flash-style recompute). The
-recompute bound: backward materializes the O(N²) probability matrix in HBM —
-fine through N≈8k on a 16GB chip (N=8192, B·H=48 ⇒ ~12GB transient at f32,
-XLA usually fuses it smaller); past that, shard the sequence instead (ring
-attention, parallel/ring_attention.py, whose backward is blocked by
-construction). The training path only hits this VJP with attention dropout
-disabled — with dropout active the model falls back to the einsum path anyway.
+Autodiff: the custom VJP is flash all the way through. The forward kernel
+additionally emits the per-row log-sum-exp; the backward runs two more Pallas
+kernels — dq (grid like the forward) and dk/dv (grid transposed: K/V blocks
+outer, q chunks streamed innermost) — that rebuild probabilities from the
+saved lse chunk by chunk, so the O(N²) matrix never exists in HBM in either
+direction. Residuals are (q, k, v, o, lse): O(N·D) — the whole train-step
+memory story for long sequences is bounded.
 
-On non-TPU backends the kernel runs in interpreter mode, so tests exercise the
-identical code path on CPU.
+On non-TPU backends the kernels run in interpreter mode, so tests exercise
+the identical code paths on CPU (GPU falls back to the dense einsum).
 """
 
 from __future__ import annotations
@@ -40,10 +39,14 @@ _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
 
 
-def _attention_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      scale: float, n_valid: int, block_kv: int, n_kv: int):
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, n_valid: int, block_kv: int, n_kv: int):
     """One (head, q-block, kv-block) program: fold this K/V chunk into the
-    running softmax state; emit o = acc/l on the last chunk."""
+    running softmax state; emit o = acc/l and lse = m + log l on the last."""
     kv_i = pl.program_id(2)
 
     @pl.when(kv_i == 0)
@@ -76,8 +79,10 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kv_i == n_kv - 1)
     def _emit():
+        m = jnp.max(m_ref[...], axis=-1, keepdims=True)
         l = jnp.max(l_ref[...], axis=-1, keepdims=True)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -87,6 +92,15 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def _to_heads(x, B, N, H, D):
+    """(B, N, H, D) → (B·H, N⁺, D⁺): one grid row per head's sequence,
+    lane-aligned head dim (zero columns are inert in q·kᵀ and produce zero
+    output columns, sliced off at the end), sublane-aligned N."""
+    x = x.transpose(0, 2, 1, 3).reshape(B * H, N, D)
+    x = _pad_to(x, 2, _LANE)
+    return _pad_to(x, 1, 8)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -104,29 +118,24 @@ def flash_attention(
     returns ``(B, N, H, D)`` in q's dtype. Softmax runs in float32 regardless
     of input dtype, matching the einsum path bit-for-bit up to GEMM precision.
     VMEM per program ≈ (block_q + 2·block_kv)·D_padded input tiles plus the
-    f32 accumulator — independent of N.
+    f32 accumulator — independent of N, forward and backward alike.
     """
-    return _flash_forward(q, k, v, scale, block_q, block_kv)
+    return _flash_forward(q, k, v, scale, block_q, block_kv)[0]
 
 
-def _flash_forward(q, k, v, scale, block_q, block_kv):
+def _use_kernel() -> bool:
     # Interpreter mode exists so CPU tests exercise the kernel path; on any
     # other non-TPU backend (e.g. GPU) interpreting would be a silent
     # orders-of-magnitude slowdown — use the dense einsum instead.
-    backend = jax.default_backend()
-    if backend not in ("tpu", "cpu"):
-        return _dense_attention_f32(q, k, v, scale)[1].astype(q.dtype)
+    return jax.default_backend() in ("tpu", "cpu")
+
+
+def _flash_forward(q, k, v, scale, block_q, block_kv):
+    if not _use_kernel():
+        return _dense_attention_f32(q, k, v, scale)[1].astype(q.dtype), None
 
     B, N, H, D = q.shape
-    # (B, N, H, D) → (B·H, N, D): each grid row owns one head's sequence.
-    def to_heads(x):
-        x = x.transpose(0, 2, 1, 3).reshape(B * H, N, D)
-        # lane-align the head dim (zero columns are inert in q·kᵀ and produce
-        # zero output columns, sliced off below) and sublane-align N.
-        x = _pad_to(x, 2, _LANE)
-        return _pad_to(x, 1, 8)
-
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    qh, kh, vh = (_to_heads(x, B, N, H, D) for x in (q, k, v))
     BH, Np, Dp = qh.shape
     bq = min(block_q, Np)
     bkv = min(block_kv, Np)
@@ -135,9 +144,9 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
     n_kv = kh.shape[1] // bkv
     grid = (BH, qh.shape[1] // bq, n_kv)
 
-    kernel = functools.partial(_attention_kernel, scale=scale, n_valid=N,
+    kernel = functools.partial(_fwd_kernel, scale=scale, n_valid=N,
                                block_kv=bkv, n_kv=n_kv)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -145,8 +154,14 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
             pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qh.shape, q.dtype),
+            jax.ShapeDtypeStruct(qh.shape[:2], jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
             pltpu.VMEM((bq, _LANE), jnp.float32),  # running max (lane-replicated)
@@ -155,15 +170,155 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-        interpret=backend == "cpu",
+        interpret=jax.default_backend() == "cpu",
     )(qh, kh, vh)
 
     out = out[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)
-    return out
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale: float, n_valid: int, block_q: int,
+                   block_kv: int, n_kv: int):
+    """dq_i = Σ_j ds_ij·k_j·scale, K/V chunks streamed innermost."""
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)    # (bq, D)
+    k = k_ref[0].astype(jnp.float32)    # (bkv, D)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # (bq, D)
+    lse = lse_ref[0]                    # (bq,)
+    delta = delta_ref[0]                # (bq,)
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, bkv)
+    # zero both padded kv columns (zero-filled k would contribute exp(−lse))
+    # and padded q rows (their lse ≈ −inf would blow up exp)
+    col = kv_i * block_kv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 0)
+    p = jnp.where((col < n_valid) & (row < n_valid),
+                  jnp.exp(logits - lse[:, None]), 0.0)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
+    ds = p * (dp - delta[:, None])
+    acc_ref[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kv_i == n_kv - 1)
+    def _emit():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    n_valid: int, block_q: int, block_kv: int, n_q: int):
+    """dv_j = Σ_i p_ijᵀ·do_i and dk_j = Σ_i ds_ijᵀ·q_i·scale — grid
+    transposed: one K/V block per (outer) program, q chunks streamed
+    innermost."""
+    q_i = pl.program_id(2)
+
+    @pl.when(q_i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)    # (bq, D)
+    k = k_ref[0].astype(jnp.float32)    # (bkv, D)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # (bq, D)
+    lse = lse_ref[0]                    # (bq,)
+    delta = delta_ref[0]                # (bq,)
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, bkv)
+    # a padded q row's garbage lse would poison VALID kv columns through the
+    # column-sum — masking rows here is correctness, not hygiene
+    row = q_i * block_q + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    col = pl.program_id(1) * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    p = jnp.where((row < n_valid) & (col < n_valid),
+                  jnp.exp(logits - lse[:, None]), 0.0)
+    dv_acc[...] += jax.lax.dot_general(  # pᵀ·do: (bkv, D)
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
+    ds = p * (dp - delta[:, None])
+    dk_acc[...] += jax.lax.dot_general(  # dsᵀ·q: (bkv, D)
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(q_i == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
+    B, N, H, D = q.shape
+    qh, kh, vh, oh, gh = (_to_heads(x, B, N, H, D) for x in (q, k, v, o, g))
+    BH, Np, Dp = qh.shape
+    bq = min(block_q, Np)
+    bkv = min(block_kv, Np)
+    qh, oh, gh = (_pad_to(x, 1, bq) for x in (qh, oh, gh))
+    kh, vh = _pad_to(kh, 1, bkv), _pad_to(vh, 1, bkv)
+    n_q, n_kv = qh.shape[1] // bq, kh.shape[1] // bkv
+    lse = _pad_to(lse, 1, bq)  # (BH, Nq⁺), from the forward kernel
+    delta = jnp.sum(oh.astype(jnp.float32) * gh.astype(jnp.float32), axis=-1)
+
+    interpret = jax.default_backend() == "cpu"
+    q_spec = pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0))
+    kv_spec_dq = pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, n_valid=N,
+                          block_q=bq, block_kv=bkv, n_kv=n_kv),
+        grid=(BH, n_q, n_kv),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    # transposed grid: (head, kv block, q chunk innermost)
+    q_spec_t = pl.BlockSpec((1, bq, Dp), lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, bkv, Dp), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, n_valid=N,
+                          block_q=bq, block_kv=bkv, n_q=n_q),
+        grid=(BH, n_kv, n_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct(kh.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vh.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bkv, Dp), jnp.float32),
+                        pltpu.VMEM((bkv, Dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    def from_heads(x):
+        return x[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)
+
+    return from_heads(dq), from_heads(dk), from_heads(dv)
 
 
 def _dense_attention_f32(q, k, v, scale):
-    """XLA-einsum oracle/backward path, f32 accumulation (ViT.py:110-114)."""
+    """XLA-einsum oracle/fallback path, f32 accumulation (ViT.py:110-114)."""
     logits = jnp.einsum(
         "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -171,13 +326,8 @@ def _dense_attention_f32(q, k, v, scale):
     return p, jnp.einsum("bhnm,bmhd->bnhd", p, v.astype(jnp.float32))
 
 
-def _flash_fwd(q, k, v, scale, block_q, block_kv):
-    return _flash_forward(q, k, v, scale, block_q, block_kv), (q, k, v)
-
-
-def _flash_bwd(scale, block_q, block_kv, residuals, g):
-    q, k, v = residuals
-    p, _ = _dense_attention_f32(q, k, v, scale)  # recompute (flash-style)
+def _dense_backward(q, k, v, g, scale):
+    p, _ = _dense_attention_f32(q, k, v, scale)
     gf = g.astype(jnp.float32)
     dv = jnp.einsum("bhnm,bnhd->bmhd", p, gf)
     dp = jnp.einsum("bnhd,bmhd->bhnm", gf, v.astype(jnp.float32))
@@ -185,6 +335,19 @@ def _flash_bwd(scale, block_q, block_kv, residuals, g):
     dq = jnp.einsum("bhnm,bmhd->bnhd", ds, k.astype(jnp.float32)) * scale
     dk = jnp.einsum("bhnm,bnhd->bmhd", ds, q.astype(jnp.float32)) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_kv):
+    out, lse = _flash_forward(q, k, v, scale, block_q, block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, block_q, block_kv, residuals, g):
+    q, k, v, o, lse = residuals
+    if lse is None:  # dense fallback path (non-TPU/CPU backends)
+        return _dense_backward(q, k, v, g, scale)
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

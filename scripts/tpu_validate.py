@@ -88,8 +88,7 @@ def main():
     # -- 3. timing: delegate to bench.py (single source of timing truth) ---
     import bench
 
-    bench_args = (["--smoke", "--skip-northstar", "--skip-e2e", "--skip-scaling"]
-                  if args.quick else ["--ksweep"])
+    bench_args = ["--smoke"] if args.quick else ["--ksweep"]
     if args.cpu:
         bench_args.append("--cpu")
     bench.main(bench_args)

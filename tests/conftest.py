@@ -24,6 +24,14 @@ jax.config.update("jax_platforms", "cpu")
 # keeps for training/bench), so pin full f32 dots for the test suite.
 jax.config.update("jax_default_matmul_precision", "float32")
 
+# The suite's wall time is dominated by ~30 jit compiles of tiny models; a
+# persistent compilation cache makes re-runs (the common local case) start
+# nearly compile-free. Fresh clones still pay the first-compile cost once.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

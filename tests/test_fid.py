@@ -129,14 +129,17 @@ def test_torch_conversion_roundtrip(small_variables):
 
 def test_fid_between_images(rng):
     """End-to-end on tiny images with the random-init extractor: a stream
-    compared against itself gives (near-)zero; against noise it does not."""
-    imgs = rng.rand(16, 32, 32, 3).astype(np.float32)
-    other = rng.rand(16, 32, 32, 3).astype(np.float32) * 0.2
+    compared against itself gives (near-)zero; against noise it does not.
+    (Small batches: each 299×299 InceptionV3 forward is ~seconds on CPU —
+    8 images over 3 forwards keeps the path covered without dominating the
+    suite's wall time.)"""
+    imgs = rng.rand(8, 32, 32, 3).astype(np.float32)
+    other = rng.rand(4, 32, 32, 3).astype(np.float32) * 0.2
     import jax
 
     feature_fn, dim = fid.make_feature_fn(*inception.init_variables(jax.random.PRNGKey(1)))
-    a = fid.stats_for_batches([imgs[:8], imgs[8:]], feature_fn, dim)
-    b = fid.stats_for_batches([imgs[:8], imgs[8:]], feature_fn, dim)
+    a = fid.stats_for_batches([imgs[:4], imgs[4:]], feature_fn, dim)
+    b = fid.stats_for_batches([imgs[:4], imgs[4:]], feature_fn, dim)
     c = fid.stats_for_batches([other], feature_fn, dim)
     assert abs(fid.fid_from_stats(a, b)) < 1e-6
     assert fid.fid_from_stats(a, c) > fid.fid_from_stats(a, b)
