@@ -64,6 +64,13 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
             params = ckpt.load_torch_pkl(path, model.patch_size)
 
     print(f"devices: {jax.devices()}")
+    # multi-chip hosts shard the sample batch over a data mesh automatically
+    # (the reference sampler is single-GPU; SPMD sampling is free here)
+    mesh = None
+    if jax.device_count() > 1 and sample_n % jax.device_count() == 0:
+        from ddim_cold_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
 
     n_seq = 6
     seq = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed), k=100,
@@ -75,7 +82,7 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
     print(f"wrote {out}")
 
     img = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed + 1),
-                               k=acc_k, n=sample_n)
+                               k=acc_k, n=sample_n, mesh=mesh)
     nrows, ncols = grid_shape(sample_n)
     out = save_grid(img, get_next_path(os.path.join(saved, "samples.png")),
                     nrows=nrows, ncols=ncols)

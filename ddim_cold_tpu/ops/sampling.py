@@ -87,6 +87,18 @@ def _ddim_scan_last(model, params, x_init, *, k: int, t_start: Optional[int]):
     return (x0_last + 1.0) / 2.0
 
 
+def _shard_init(x_init: jax.Array, mesh) -> jax.Array:
+    """Place the sample batch sharded over the mesh's 'data' axis: the whole
+    scan then runs SPMD (params replicated, one psum-free forward per shard)
+    — multi-chip sampling the reference's single-GPU sampler has no analogue
+    for. The batch must divide over the data axis."""
+    if mesh is None:
+        return x_init
+    from ddim_cold_tpu.parallel.mesh import batch_sharding
+
+    return jax.device_put(x_init, batch_sharding(mesh))
+
+
 def ddim_sample(
     model,
     params,
@@ -97,6 +109,7 @@ def ddim_sample(
     x_init: Optional[jax.Array] = None,
     t_start: Optional[int] = None,
     return_sequence: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """k-strided DDIM sampling; returns images in [0, 1], NHWC.
 
@@ -106,12 +119,15 @@ def ddim_sample(
 
     ``return_sequence=True`` returns the (n_steps+1, N, H, W, C) trajectory of
     the initial noise plus every x̂0 prediction (the denoise-sequence figure).
+    With a ``mesh``, the batch is sharded over its 'data' axis and the scan
+    runs SPMD across the chips.
     """
     if x_init is None:
         if rng is None:
             raise ValueError("ddim_sample needs either rng or x_init")
         H, W = model.img_size
         x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    x_init = _shard_init(x_init, mesh)
     if return_sequence:
         return _ddim_scan_sequence(model, params, x_init, k=k, t_start=t_start)
     return _ddim_scan_last(model, params, x_init, k=k, t_start=t_start)
@@ -204,14 +220,17 @@ def cold_sample(
     n: int = 49,
     levels: int = 6,
     return_sequence: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """Cold-diffusion sampling from per-sample constant-color "noise".
 
     The init is a single N(0,1) RGB color per sample broadcast over the image
     (reference ViT_draft2drawing.py:264 — the fully-downsampled degenerate
-    state); ``levels`` defaults to 6 = log2(64).
+    state); ``levels`` defaults to 6 = log2(64). With a ``mesh``, the batch
+    runs SPMD sharded over its 'data' axis (see ``ddim_sample``).
     """
     H, W = model.img_size
     color = jax.random.normal(rng, (n, 1, 1, model.in_chans), jnp.float32)
     x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
+    x_init = _shard_init(x_init, mesh)
     return _cold_scan(model, params, x_init, levels=levels, return_sequence=return_sequence)

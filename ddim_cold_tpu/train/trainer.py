@@ -32,14 +32,8 @@ import numpy as np
 from ddim_cold_tpu.config import ExperimentConfig
 from ddim_cold_tpu.data import ColdDownSampleDataset, DiffusionDataset, ShardedLoader
 from ddim_cold_tpu.models import DiffusionViT
-from ddim_cold_tpu.parallel import (
-    make_mesh,
-    make_pipelined_apply,
-    param_partition_specs,
-    pipeline_param_specs,
-    shard_batch,
-    shard_train_state,
-)
+from ddim_cold_tpu.parallel import make_mesh, shard_batch, shard_train_state
+from ddim_cold_tpu.parallel.layout import layout_for_mesh
 from ddim_cold_tpu.train.step import create_train_state, make_eval_step, make_train_step
 from ddim_cold_tpu.utils import checkpoint as ckpt
 from ddim_cold_tpu.utils import profiling
@@ -221,14 +215,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     # parallelism-dependent param layout: pipeline shards the stacked blocks
     # over 'pipe'; tensor parallelism shards Megatron column/row kernels over
     # 'model'; pure-dp stays replicated (gradient psum implicit in jit).
-    apply_fn = None
-    if pipe_stages > 1:
-        specs = pipeline_param_specs(state.params)
-        apply_fn = make_pipelined_apply(model, mesh, n_microbatch=n_micro)
-    elif int(mesh.shape.get("model", 1)) > 1:
-        specs = param_partition_specs(state.params)
-    else:
-        specs = None
+    specs, apply_fn = layout_for_mesh(model, mesh, state.params,
+                                      n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
     train_step = make_train_step(model, apply_fn)
     eval_step = make_eval_step(model, apply_fn)

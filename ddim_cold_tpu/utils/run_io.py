@@ -1,0 +1,38 @@
+"""Load a finished training run (config + model + best params) from its
+``Saved_Models/<run>/`` directory — shared by the publishing/eval scripts
+(scripts/publish_run.py, scripts/compute_fid.py).
+
+The run dir is self-describing: the launcher copies the experiment yaml into
+it (multi_gpu_trainer.py, mirroring reference :201), and ``bestloss.ckpt``
+holds the best-val params. Restoring goes through a freshly-initialized
+template tree so a checkpoint written on one topology (the TPU) loads on
+another (a CPU publish host) — see utils/checkpoint.py restore_args.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def load_run(run_dir: str):
+    """→ (config, model, params) for the run's best checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddim_cold_tpu.config import load_config
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    yamls = [f for f in os.listdir(run_dir) if f.endswith(".yaml")]
+    if not yamls:
+        raise FileNotFoundError(f"no experiment yaml in {run_dir}")
+    config = load_config(os.path.join(run_dir, yamls[0]),
+                         os.path.splitext(yamls[0])[0])
+    model = DiffusionViT(dtype=jnp.bfloat16, **config.model_kwargs())
+    template = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, *config.image_size, 3)), jnp.zeros((1,), jnp.int32),
+    )["params"]
+    params = ckpt.restore_checkpoint(
+        os.path.join(run_dir, "bestloss.ckpt"), template)
+    return config, model, params

@@ -63,10 +63,8 @@ def main(argv=None):
     import numpy as np
 
     from ddim_cold_tpu.config import ExperimentConfig
-    from ddim_cold_tpu.parallel import (
-        make_mesh, make_pipelined_apply, param_partition_specs,
-        pipeline_param_specs, shard_batch, shard_train_state,
-    )
+    from ddim_cold_tpu.parallel import make_mesh, shard_batch, shard_train_state
+    from ddim_cold_tpu.parallel.layout import layout_for_mesh
     from ddim_cold_tpu.train.step import create_train_state, make_train_step
     from ddim_cold_tpu.train.trainer import build_model
 
@@ -97,13 +95,9 @@ def main(argv=None):
         model = build_model(cfg, mesh=mesh)
         state = create_train_state(model, jax.random.PRNGKey(0), 1e-3, 1000,
                                    batch)
-        apply_fn, specs = None, None
-        pipe = int(mesh.shape.get("pipe", 1))
-        if pipe > 1:
-            specs = pipeline_param_specs(state.params)
-            apply_fn = make_pipelined_apply(model, mesh, n_microbatch=2 * pipe)
-        elif int(mesh.shape.get("model", 1)) > 1:
-            specs = param_partition_specs(state.params)
+        specs, apply_fn = layout_for_mesh(
+            model, mesh, state.params,
+            n_microbatch=2 * int(mesh.shape.get("pipe", 1)))
         state = shard_train_state(state, mesh, specs)
         step = make_train_step(model, apply_fn)
         b = shard_batch(batch, mesh)

@@ -82,29 +82,13 @@ def render_samples(run_dir: str, out_dir: str, *, n: int = 16):
     sequence (the reference's two acceptance figures, ViT.py:283-305,
     ViT_draft2drawing.py:364-376)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from ddim_cold_tpu.config import load_config
-    from ddim_cold_tpu.models import DiffusionViT
     from ddim_cold_tpu.ops import sampling
-    from ddim_cold_tpu.utils import checkpoint as ckpt
     from ddim_cold_tpu.utils.image import save_grid
+    from ddim_cold_tpu.utils.run_io import load_run
 
-    yamls = [f for f in os.listdir(run_dir) if f.endswith(".yaml")]
-    if not yamls:
-        raise FileNotFoundError(f"no config yaml in {run_dir}")
-    config = load_config(os.path.join(run_dir, yamls[0]),
-                         os.path.splitext(yamls[0])[0])
-    model = DiffusionViT(dtype=jnp.bfloat16, **config.model_kwargs())
-    # restore against a template tree: the checkpoint's saved shardings name
-    # the training devices (TPU), which a CPU publish doesn't have
-    template = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((1, *config.image_size, 3)), jnp.zeros((1,), jnp.int32),
-    )["params"]
-    params = ckpt.restore_checkpoint(
-        os.path.join(run_dir, "bestloss.ckpt"), template)
+    _, model, params = load_run(run_dir)
 
     # cold-model grids: the 6-step cold sampler is the trained regime
     side = int(np.sqrt(n))

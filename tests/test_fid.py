@@ -143,3 +143,16 @@ def test_fid_between_images(rng):
     c = fid.stats_for_batches([other], feature_fn, dim)
     assert abs(fid.fid_from_stats(a, b)) < 1e-6
     assert fid.fid_from_stats(a, c) > fid.fid_from_stats(a, b)
+
+
+def test_random_extractor_features_do_not_collapse(rng):
+    """Regression: with default lecun conv init the 94-conv stack attenuates
+    activations to ~1e-4 std and every FID computes as ≈0; init_variables
+    applies the √2 ReLU gain so seeded-random features stay discriminative."""
+    import jax
+    import jax.numpy as jnp
+
+    feature_fn, _ = fid.make_feature_fn(*inception.init_variables(jax.random.PRNGKey(0)))
+    imgs = rng.rand(4, 32, 32, 3).astype(np.float32)
+    feats = np.asarray(feature_fn(jnp.asarray(imgs)))
+    assert feats.std() > 0.05, f"collapsed features: std={feats.std()}"
