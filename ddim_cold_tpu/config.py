@@ -27,7 +27,10 @@ sizes for multi-chip layouts, e.g. ``{data: 4, model: 2}``), ``use_flash``
 (gradient checkpointing per block — HBM for FLOPs on big configs),
 ``profile_steps`` (device-trace the first N steps into ``<run_dir>/trace``)
 and ``nan_checks`` (``jax_debug_nans`` for the run). A ``seq`` axis in
-``mesh`` (e.g. ``{data: 4, seq: 2}``) turns on ring-attention sequence
+``mesh`` (e.g. ``{data: 4, seq: 2}``) turns on sequence
+parallelism — ``sp_mode`` selects the strategy: ``ring`` (K/V rotation,
+default, parallel/ring_attention.py) or ``ulysses`` (all-to-all head
+resharding, parallel/ulysses.py; heads must divide the seq axis)
 parallelism (parallel/ring_attention.py); a ``pipe`` axis (with optional
 ``microbatches``) turns on GPipe pipeline parallelism over the stacked
 ``scan_blocks`` layout (parallel/pipeline.py).
@@ -66,6 +69,7 @@ class ExperimentConfig:
     mesh: Optional[dict[str, int]] = None
     use_flash: bool = False
     use_sincos_pos: bool = False
+    sp_mode: str = "ring"  # seq-parallel strategy: ring | ulysses
     remat: bool = False
     profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
     nan_checks: bool = False  # jax_debug_nans for the whole run
@@ -120,6 +124,12 @@ class ExperimentConfig:
         )
 
 
+def _check_sp_mode(value: str) -> str:
+    if value not in ("ring", "ulysses"):
+        raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got {value!r}")
+    return value
+
+
 def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentConfig:
     """Parse a reference-schema YAML into an ExperimentConfig."""
     with open(yaml_path) as f:
@@ -149,6 +159,7 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         mesh=raw.get("mesh"),
         use_flash=bool(raw.get("use_flash", False)),
         use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
+        sp_mode=_check_sp_mode(raw.get("sp_mode", "ring")),
         remat=bool(raw.get("remat", False)),
         profile_steps=int(raw.get("profile_steps", 0)),
         nan_checks=bool(raw.get("nan_checks", False)),

@@ -125,10 +125,13 @@ class Attention(nn.Module):
     # sequence parallelism: rotate K/V blocks around `seq_axis` of `seq_mesh`
     # (parallel/ring_attention.py); `batch_axis` keeps dp sharding composed,
     # `head_axis` keeps tensor-parallel head sharding effective inside the ring.
+    # `sp_mode` picks the strategy: "ring" (ppermute K/V rotation) or
+    # "ulysses" (all-to-all head↔seq reshard, parallel/ulysses.py).
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None
+    sp_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -164,13 +167,27 @@ class Attention(nn.Module):
                 f"(attn_drop={self.attn_drop} active in training); set "
                 "attn_drop_rate=0.0 on the model")
         if seq_parallel and weightless_ok:
-            from ddim_cold_tpu.parallel.ring_attention import ring_self_attention
+            if self.sp_mode == "ulysses":
+                from ddim_cold_tpu.parallel.ulysses import ulysses_self_attention
 
-            out = ring_self_attention(
-                q, k, v, self.seq_mesh,
-                axis=self.seq_axis, batch_axis=self.batch_axis,
-                head_axis=self.head_axis, scale=scale,
-            ).astype(self.dtype)
+                if self.head_axis is not None:
+                    raise ValueError(
+                        "ulysses sp shards heads over the seq axis itself — "
+                        "it cannot compose with tensor-parallel head "
+                        "sharding; use sp_mode='ring' on tp×sp meshes")
+                out = ulysses_self_attention(
+                    q, k, v, self.seq_mesh,
+                    axis=self.seq_axis, batch_axis=self.batch_axis,
+                    scale=scale, use_flash=self.use_flash,
+                ).astype(self.dtype)
+            else:
+                from ddim_cold_tpu.parallel.ring_attention import ring_self_attention
+
+                out = ring_self_attention(
+                    q, k, v, self.seq_mesh,
+                    axis=self.seq_axis, batch_axis=self.batch_axis,
+                    head_axis=self.head_axis, scale=scale,
+                ).astype(self.dtype)
             attn = None
         elif self.use_flash and weightless_ok:
             from ddim_cold_tpu.ops.flash_attention import flash_attention
@@ -212,6 +229,7 @@ class Block(nn.Module):
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None
+    sp_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -231,6 +249,7 @@ class Block(nn.Module):
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
+            sp_mode=self.sp_mode,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -366,6 +385,7 @@ class DiffusionViT(nn.Module):
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None  # tp axis for head-sharded ring attention
+    sp_mode: str = "ring"  # "ring" | "ulysses" (all-to-all head resharding)
     scan_blocks: bool = False  # nn.scan over depth: params stacked on a
     # leading layer axis (O(1) compile in depth; pipeline-parallel substrate)
 
@@ -452,6 +472,7 @@ class DiffusionViT(nn.Module):
                 dtype=self.dtype, use_flash=self.use_flash,
                 seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, head_axis=self.head_axis,
+                sp_mode=self.sp_mode,
                 # the shell's field module binds to THIS scope, not the
                 # shell's — name it so params land under "blocks"
                 name="blocks",
@@ -488,6 +509,7 @@ class DiffusionViT(nn.Module):
                     seq_axis=self.seq_axis,
                     batch_axis=self.batch_axis,
                     head_axis=self.head_axis,
+                    sp_mode=self.sp_mode,
                 )
                 probe = (return_attention_layer is not None
                          and i == return_attention_layer % self.depth)

@@ -85,6 +85,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
+def _sds(shape, dtype, like: jax.Array) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes type — needed
+    when the kernel runs inside a ``shard_map`` (e.g. as Ulysses' local
+    attention) where ``check_vma`` requires outputs to declare their vma."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     pad = (-x.shape[axis]) % multiple
     if pad == 0:
@@ -159,8 +169,8 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qh.shape, q.dtype),
-            jax.ShapeDtypeStruct(qh.shape[:2], jnp.float32),
+            _sds(qh.shape, q.dtype, qh),
+            _sds(qh.shape[:2], jnp.float32, qh),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
@@ -284,7 +294,7 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
         grid=(BH, n_q, n_kv),
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        out_shape=_sds(qh.shape, q.dtype, qh),
         scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -302,8 +312,8 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
-        out_shape=[jax.ShapeDtypeStruct(kh.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vh.shape, v.dtype)],
+        out_shape=[_sds(kh.shape, k.dtype, kh),
+                   _sds(vh.shape, v.dtype, vh)],
         scratch_shapes=[pltpu.VMEM((bkv, Dp), jnp.float32),
                         pltpu.VMEM((bkv, Dp), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

@@ -1,0 +1,99 @@
+"""Ulysses sequence parallelism — all-to-all head↔sequence resharding.
+
+The second sequence-parallel strategy next to the ring (ring_attention.py),
+after DeepSpeed-Ulysses (arXiv:2309.14509). Instead of rotating K/V around
+the ring for ``S−1`` steps, the sequence-sharded activations are reshaped
+with ONE all-to-all so each device holds the FULL sequence for ``H/S`` of
+the heads, runs an ordinary local attention (dense einsum or the Pallas
+flash kernel — softmax is per-head, so no cross-device softmax state at
+all), and a second all-to-all restores sequence sharding.
+
+Trade-off vs the ring: 2 all-to-alls of activation-sized payload vs S−1
+ppermutes of K/V-sized payload with blockwise-softmax arithmetic — Ulysses
+wins when heads are plentiful and the interconnect handles all-to-all well
+(TPU ICI does); the ring wins when ``H < S`` or per-step overlap with
+compute matters. Select per-run with ``sp_mode: ulysses`` in the YAML.
+
+Requires ``num_heads % S == 0`` (head sharding) — the ring has no such
+constraint.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def ulysses_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    batch_axis: Optional[str] = None,
+    scale: Optional[float] = None,
+    use_flash: bool = False,
+) -> jax.Array:
+    """Global-array front end, mirror of ``ring_self_attention``.
+
+    q/k/v are ``(B, N, H, D)`` global arrays with the sequence dim sharded
+    over ``axis``; returns the dense-softmax result with the same sharding.
+    ``batch_axis`` keeps dp composition (each (data, seq) device row holds a
+    (B/dp, N/sp) tile). Padding tokens (N rarely divides S) are sliced off
+    *after* the gather-side all-to-all, so neither the local attention nor
+    the flash kernel ever sees them.
+    """
+    B, N, H, D = q.shape
+    if scale is None:
+        scale = D**-0.5
+    parts = int(mesh.shape[axis])
+    if H % parts != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({H}) divisible by the '{axis}' axis "
+            f"({parts}); use sp_mode='ring' otherwise")
+    n_pad = (-N) % parts
+    if n_pad:
+        pad = [(0, 0), (0, n_pad), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    Np = N + n_pad
+
+    def per_device(q, k, v):  # (B', Np/S, H, D)
+        # seq-sharded → head-sharded: every device gets the whole sequence
+        # for its H/S heads
+        gather = partial(jax.lax.all_to_all, axis_name=axis,
+                         split_axis=2, concat_axis=1, tiled=True)
+        qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H/S, D)
+        qf, kf, vf = (x[:, :N] for x in (qf, kf, vf))  # drop ring padding
+
+        if use_flash:
+            from ddim_cold_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(qf, kf, vf, scale).astype(q.dtype)
+        else:
+            logits = jnp.einsum(
+                "bnhd,bmhd->bhnm", qf.astype(jnp.float32),
+                kf.astype(jnp.float32)) * scale
+            p = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum(
+                "bhnm,bmhd->bnhd", p, vf.astype(jnp.float32)).astype(q.dtype)
+
+        out = jnp.pad(out, [(0, 0), (0, n_pad), (0, 0), (0, 0)])
+        # head-sharded → seq-sharded
+        return jax.lax.all_to_all(out, axis_name=axis,
+                                  split_axis=1, concat_axis=2, tiled=True)
+
+    seq_spec = P(batch_axis, axis, None, None)
+    # check_vma off: the body is stateless (two all-to-alls around a local
+    # attention), and the Pallas kernel's internal jaxpr trips the vma
+    # matcher in interpret mode (mixed varying/constant dynamic_slice)
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(seq_spec, seq_spec, seq_spec),
+                   out_specs=seq_spec, check_vma=False)
+    out = fn(q, k, v)
+    return out[:, :N]

@@ -90,7 +90,8 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
         batch_axis = "data" if "data" in mesh_shape else None
         head_axis = "model" if int(mesh_shape.get("model", 1)) > 1 else None
         kwargs.update(seq_mesh=mesh, seq_axis="seq", batch_axis=batch_axis,
-                      head_axis=head_axis, attn_drop_rate=0.0)
+                      head_axis=head_axis, attn_drop_rate=0.0,
+                      sp_mode=config.sp_mode)
     return DiffusionViT(
         dtype=jnp.bfloat16 if config.amp else jnp.float32, **kwargs
     )

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 
@@ -77,20 +78,33 @@ def main(argv=None):
     # -- real stream: clean val images in [0,1] -----------------------------
     ds = ColdDownSampleDataset(args.val_dir, imgSize=tuple(config.image_size),
                                target_mode="direct")
+    n_real_seen = 0
 
     def real_batches():
+        nonlocal n_real_seen
         loader = ShardedLoader(ds, args.batch, shuffle=False, drop_last=True)
-        seen = 0
         for noisy, clean, t in loader:  # target of the direct mode is x0
+            if n_real_seen >= args.n_real:
+                break
             yield (clean + 1.0) / 2.0
-            seen += clean.shape[0]
-            if seen >= args.n_real:
-                return
+            n_real_seen += clean.shape[0]
+
+    # multi-chip hosts shard the sample batch over a data mesh (the samplers'
+    # SPMD path); cold levels follow the run's image size — the trained
+    # regime is t ∈ [1, log2(H)], not the 64px default
+    mesh = None
+    if jax.device_count() > 1 and args.batch % jax.device_count() == 0:
+        from ddim_cold_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": jax.device_count()})
+    levels = int(math.log2(config.image_size[0]))
 
     def sampler(rng, nb):
         if args.sampler == "cold":
-            return sampling.cold_sample(model, params, rng, n=nb)
-        return sampling.ddim_sample(model, params, rng, k=args.k, n=nb)
+            return sampling.cold_sample(model, params, rng, n=nb,
+                                        levels=levels, mesh=mesh)
+        return sampling.ddim_sample(model, params, rng, k=args.k, n=nb,
+                                    mesh=mesh)
 
     value = fid.compute_fid(
         model, params, real_batches(), rng=jax.random.PRNGKey(1),
@@ -104,7 +118,7 @@ def main(argv=None):
         "metric": f"fid_{args.sampler}" + (f"_k{args.k}" if args.sampler == "ddim" else ""),
         "value": round(float(value), 4),
         "n_samples": args.n_samples,
-        "n_real": args.n_real,
+        "n_real": n_real_seen,  # actually accumulated, not requested
         "extractor": provenance,
         "run": run,
     }
