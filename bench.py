@@ -122,8 +122,18 @@ def main(argv=None):
         f"{args.steps} steps @ b{B}: {1000*spi:.2f} ms/step "
         f"({img_per_sec:.0f} img/s, mfu={train_mfu if train_mfu is None else round(train_mfu, 4)})")
 
+    def section(name, fn):
+        """Sections after the headline are best-effort: a failure (OOM on a
+        small chip, missing native lib, …) records an error string instead of
+        losing the whole BENCH record."""
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — deliberate catch-all
+            log(f"{name} section failed: {type(e).__name__}: {e}")
+            sub[name + "_error"] = f"{type(e).__name__}: {e}"
+
     # --------------------------------------------------------- batch scaling
-    if not args.skip_scaling:
+    def run_scaling():
         rows = []
         for b in (64, 128, 256):
             bt = synth_batch(b)
@@ -139,6 +149,9 @@ def main(argv=None):
             log(f"scaling b{b}: {1000*sp:.2f} ms/step ({b/sp:.0f} img/s, "
                 f"mfu={rows[-1]['mfu']})")
         sub["batch_scaling"] = rows
+
+    if not args.skip_scaling:
+        section("batch_scaling", run_scaling)
 
     # ------------------------------------------------------------- samplers
     def time_ddim(smodel, sparams, k, n, label):
@@ -160,17 +173,25 @@ def main(argv=None):
 
     timed = {}
     n_sample = 8 if args.smoke else 64
-    k20 = time_ddim(model, state.params, 20, n_sample, "vit_tiny 64px")
-    sub["sampler_throughput_64px_k20"] = {
-        "value": round(n_sample / k20, 2), "unit": "img/s/chip"}
-    if args.ksweep:
+
+    def run_sampler64():
+        k20 = time_ddim(model, state.params, 20, n_sample, "vit_tiny 64px")
+        sub["sampler_throughput_64px_k20"] = {
+            "value": round(n_sample / k20, 2), "unit": "img/s/chip"}
+
+    section("sampler_64px", run_sampler64)
+
+    def run_ksweep():
         sweep = {}
         for k in (5, 20, 50) if args.smoke else (1, 5, 20, 50):
             sweep[str(k)] = round(
                 n_sample / time_ddim(model, state.params, k, n_sample, "k-sweep"), 2)
         sub["ksweep_64px_img_per_sec"] = sweep
 
-    if not args.skip_northstar:
+    if args.ksweep:
+        section("ksweep", run_ksweep)
+
+    def run_northstar():
         # the acceptance metric: 200px DDIM k=20 img/s/chip (BASELINE.json)
         n, k = 16, 20
         ns_params = None
@@ -191,10 +212,12 @@ def main(argv=None):
         sub["sampler_throughput_200px_k20"] = {
             "value": best, "unit": "img/s/chip", "n": n, "k": k}
 
+    if not args.skip_northstar:
+        section("northstar", run_northstar)
+
     # ------------------------------------------------- e2e with the data path
     if not args.skip_e2e:
-        e2e = _bench_e2e(args, state, train_step, log)
-        sub.update(e2e)
+        section("e2e", lambda: sub.update(_bench_e2e(args, state, train_step, log)))
 
     print(json.dumps({
         "metric": "train_throughput_vit_tiny64_b32",
