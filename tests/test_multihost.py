@@ -31,6 +31,10 @@ def test_two_process_distributed_train_step(tmp_path):
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=4",
         JAX_ENABLE_X64="0",
+        # share the suite's persistent compile cache (conftest.py) so rerun
+        # workers skip their XLA compiles
+        JAX_COMPILATION_CACHE_DIR=os.path.join(
+            os.path.dirname(__file__), ".jax_cache"),
     )
     procs = [
         subprocess.Popen(
