@@ -74,6 +74,15 @@ class ExperimentConfig:
     profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
     nan_checks: bool = False  # jax_debug_nans for the whole run
     cache_images: object = None  # None=auto (fits 2GB), True/False=force
+    # device-side corruption (cold datasets): ship (base, t), degrade in-jit.
+    # Bit-identical to the host path (gather op, tests/test_device_path.py)
+    # and 2× less host→device traffic (one float image instead of the two
+    # degraded copies); False forces the host/C++ pipeline.
+    device_degrade: bool = True
+    # overlap epoch-end checkpoint writes with the next epoch's compute (costs
+    # one transient on-device params+opt_state copy); multi-host runs are
+    # always synchronous (collective orbax writes must not be reordered)
+    async_checkpoint: bool = True
     scan_blocks: bool = False  # nn.scan over depth (stacked params)
     microbatches: Optional[int] = None  # pipeline microbatches (default 2·pipe)
 
@@ -164,6 +173,8 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         profile_steps=int(raw.get("profile_steps", 0)),
         nan_checks=bool(raw.get("nan_checks", False)),
         cache_images=raw.get("cache_images"),
+        device_degrade=bool(raw.get("device_degrade", True)),
+        async_checkpoint=bool(raw.get("async_checkpoint", True)),
         scan_blocks=bool(raw.get("scan_blocks", False)),
         microbatches=(int(raw["microbatches"]) if "microbatches" in raw else None),
     )

@@ -53,7 +53,12 @@ class ShardedLoader:
         num_threads: int = 8,
         prefetch: int = 2,
         pad_final_batch: bool = False,
+        raw: bool = False,
     ):
+        if raw and not hasattr(dataset, "get_raw_batch"):
+            raise ValueError(
+                f"raw=True needs dataset.get_raw_batch; {type(dataset).__name__} "
+                "has none (device-side corruption is a cold-dataset path)")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -64,6 +69,7 @@ class ShardedLoader:
         self.num_threads = num_threads
         self.prefetch = prefetch
         self.pad_final_batch = pad_final_batch
+        self.raw = raw
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -109,6 +115,8 @@ class ShardedLoader:
         return noisy, target, t
 
     def _make_batch(self, idxs: np.ndarray, pool: Optional[ThreadPoolExecutor] = None):
+        if self.raw:  # (base, t) only — corruption happens on device (in-jit)
+            return self.dataset.get_raw_batch(idxs, num_threads=max(1, self.num_threads))
         # native fast path: the dataset assembles the whole batch in C++
         # threads (decode/resize/degrade/collate outside the GIL); None means
         # "not available for this batch" → per-item python path.
@@ -133,44 +141,66 @@ class ShardedLoader:
         # one producer thread decodes batch-by-batch (items fan out over the
         # pool); the bounded queue caps live memory at prefetch+1 batches and
         # an abandoned iterator stops decoding within one batch.
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
+        with ThreadPoolExecutor(self.num_threads) as pool:
+            yield from _background_map(
+                batches, lambda b: self._make_batch(b, pool), self.prefetch)
 
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
 
-        def producer():
+def _background_map(items, fn, depth: int):
+    """Yield ``fn(item)`` with the mapping running ``depth`` items ahead in a
+    producer thread (bounded queue). Exceptions from ``fn`` or the iterator
+    surface at the consuming ``next()``; abandoning the generator (break/
+    close) stops the producer within one item. Shared machinery for the
+    decode pipeline (ShardedLoader) and the H2D overlap (device_prefetch).
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
             try:
-                with ThreadPoolExecutor(self.num_threads) as pool:
-                    for b in batches:
-                        if stop.is_set() or not put(self._make_batch(b, pool)):
-                            return
-                put(None)
-            except BaseException as e:  # surface decode errors to the consumer
-                put(e)
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        thread = threading.Thread(target=producer, daemon=True)
-        thread.start()
+    def producer():
         try:
-            while True:
-                item = q.get()
-                if item is None:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            # unblock a producer waiting on a full queue, then reap it
-            while thread.is_alive():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    pass
-                thread.join(timeout=0.2)
+            for it in items:
+                if stop.is_set() or not put(fn(it)):
+                    return
+            put(None)
+        except BaseException as e:  # surface work errors to the consumer
+            put(e)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # unblock a producer waiting on a full queue, then reap it
+        while thread.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.2)
+
+
+def device_prefetch(batches, place, depth: int = 2):
+    """Yield ``place(batch)`` for each host batch, with the placement (the
+    host→device copy) running ``depth`` batches ahead in a background thread.
+
+    On network-attached TPU hosts ``jax.device_put`` blocks on the upload RPC,
+    so an unprefetched loop serializes transfer and compute; this overlaps
+    them (the JAX client is thread-safe for placement).
+    """
+    return _background_map(batches, place, depth)

@@ -47,3 +47,37 @@ def cold_degrade(imgs: jax.Array, t: jax.Array, *, size: int, max_step: int = 6)
         return img[idx][:, idx]
 
     return jax.vmap(one)(imgs, t.astype(jnp.int32))
+
+
+def normalize_base(base: jax.Array) -> jax.Array:
+    """Raw base image → float32 in [−1, 1] with the host pipeline's exact op
+    order (÷255 then ·2−1, datasets._load_base) so a uint8-shipped batch is
+    bit-identical to the host-normalized float path. Float input passes
+    through (already normalized host-side)."""
+    if base.dtype == jnp.uint8:
+        return base.astype(jnp.float32) / 255.0 * 2.0 - 1.0
+    return base
+
+
+def make_cold_prepare(size: int, max_step: int, chain: bool):
+    """In-jit batch corruption for the device-side cold data path.
+
+    The host ships only ``(base, t)`` — one clean image per sample instead of
+    the two degraded float copies (2× less host→device traffic, the dominant
+    step cost on network-attached TPU hosts) — and this hook (train/step.py
+    ``prepare``) rebuilds the exact host contract ``(D(x,t), D(x,t−1)|x₀, t)``
+    on device. The degradation is a pure gather (cold_degrade), so the result
+    is bit-identical to the host/C++ pipeline. ``normalize_base`` additionally
+    accepts uint8 bases (a further 4× for identity-resize datasets) for
+    callers that ship raw bytes.
+    """
+
+    def prepare(batch, rng):
+        del rng  # cold corruption is deterministic given (base, t)
+        base, t = batch
+        x = normalize_base(base)
+        noisy = cold_degrade(x, t, size=size, max_step=max_step)
+        target = cold_degrade(x, t - 1, size=size, max_step=max_step) if chain else x
+        return noisy, target, t
+
+    return prepare

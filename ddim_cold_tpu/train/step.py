@@ -49,7 +49,8 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
     )
 
 
-def make_train_step(model, apply_fn: Optional[Callable] = None) -> Callable:
+def make_train_step(model, apply_fn: Optional[Callable] = None,
+                    prepare: Optional[Callable] = None) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
@@ -59,12 +60,22 @@ def make_train_step(model, apply_fn: Optional[Callable] = None) -> Callable:
 
     ``apply_fn`` overrides ``model.apply`` with the same signature — the hook
     pipeline parallelism uses (parallel.pipeline.make_pipelined_apply).
+
+    ``prepare`` is the device-side corruption hook: ``(raw_batch, rng) →
+    (noisy, target, t)`` traced into the step (ops/degrade.make_cold_prepare),
+    letting the host ship clean bases instead of degraded pairs.
     """
     apply_fn = apply_fn or model.apply
 
     @partial(jax.jit, donate_argnums=(0, 3))
     def train_step(state: train_state.TrainState, batch, rng: jax.Array,
                    loss_rec: jax.Array):
+        if prepare is not None:
+            # distinct fold constant: fold_in(rng, step+1) would be bit-equal
+            # to the NEXT step's dropout key, correlating a stochastic
+            # prepare's noise with the following step's dropout mask
+            batch = prepare(
+                batch, jax.random.fold_in(jax.random.fold_in(rng, 0x5EED), state.step))
         noisy, target, t = batch
         dropout_rng = jax.random.fold_in(rng, state.step)
 
@@ -81,11 +92,14 @@ def make_train_step(model, apply_fn: Optional[Callable] = None) -> Callable:
     return train_step
 
 
-def make_eval_step(model, apply_fn: Optional[Callable] = None) -> Callable:
+def make_eval_step(model, apply_fn: Optional[Callable] = None,
+                   prepare: Optional[Callable] = None) -> Callable:
     apply_fn = apply_fn or model.apply
 
     @jax.jit
     def eval_step(params, batch):
+        if prepare is not None:
+            batch = prepare(batch, jax.random.PRNGKey(0))
         noisy, target, t = batch
         pred = apply_fn({"params": params}, noisy, t, deterministic=True)
         return smooth_l1(pred, target)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -31,6 +32,8 @@ import numpy as np
 
 from ddim_cold_tpu.config import ExperimentConfig
 from ddim_cold_tpu.data import ColdDownSampleDataset, DiffusionDataset, ShardedLoader
+from ddim_cold_tpu.data.loader import device_prefetch
+from ddim_cold_tpu.ops import degrade
 from ddim_cold_tpu.models import DiffusionViT
 from ddim_cold_tpu.parallel import make_mesh, shard_batch, shard_train_state
 from ddim_cold_tpu.parallel.layout import layout_for_mesh
@@ -46,6 +49,44 @@ class TrainResult:
     last_val_loss: float
     steps: int
     run_dir: str
+
+
+class _AsyncSaver:
+    """Runs each epoch's checkpoint writes in a background thread so the
+    device→host pull + serialization overlap the next epoch's compute (the
+    writes were ~half the epoch wall time on a tunneled TPU host). At most one
+    epoch's saves are in flight (``wait`` before the next ``submit``); save
+    errors re-raise at the next wait point. Multi-host runs stay synchronous —
+    orbax saves are collective and host-side thread scheduling must not
+    reorder them against other collectives.
+    """
+
+    def __init__(self, sync: bool):
+        self.sync = sync
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, fn) -> None:
+        if self.sync:
+            fn()
+            return
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # re-raised on the main thread at wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
 
 
 def _fully_addressable(tree) -> bool:
@@ -149,14 +190,25 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     shard_index, shard_count = jax.process_index(), jax.process_count()
     train_set = _build_dataset(config, config.data_storage[0])
     test_set = _build_dataset(config, config.data_storage[1])
+    # device-side corruption: cold datasets ship (base, t) and the jitted step
+    # rebuilds (D(x,t), target, t) on device — bit-identical gathers, ~3× less
+    # host→device traffic (the dominant per-step cost on tunneled TPU hosts)
+    raw_path = config.device_degrade and config.dataset in ("cold", "cold_direct")
+    prepare = None
+    if raw_path:
+        prepare = degrade.make_cold_prepare(
+            size=int(config.image_size[0]), max_step=train_set.max_step,
+            chain=(config.dataset == "cold"))
     train_loader = ShardedLoader(
         train_set, global_batch // shard_count, shuffle=True, seed=config.seed,
         drop_last=True, shard_index=shard_index, shard_count=shard_count,
+        raw=raw_path,
     )
     test_loader = ShardedLoader(
         test_set, global_batch // shard_count, shuffle=False, drop_last=False,
         shard_index=shard_index, shard_count=shard_count,
         pad_final_batch=True,  # sharded leading dim needs even divisibility
+        raw=raw_path,
     )
     train_batches, test_batches = len(train_loader), len(test_loader)
     if train_batches == 0:
@@ -219,8 +271,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     specs, apply_fn = layout_for_mesh(model, mesh, state.params,
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
-    train_step = make_train_step(model, apply_fn)
-    eval_step = make_eval_step(model, apply_fn)
+    train_step = make_train_step(model, apply_fn, prepare=prepare)
+    eval_step = make_eval_step(model, apply_fn, prepare=prepare)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
 
@@ -236,67 +288,96 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     loss_rec_dev = jnp.float32(loss_rec)
     time_start = time.time()
     done = False
-    for epoch in range(epoch_start, config.epoch[1]):
-        train_loader.set_epoch(epoch)
-        for batch in train_loader:
-            state, _, loss_rec_dev = train_step(
-                state, shard_batch(batch, mesh), step_rng, loss_rec_dev
-            )
-            steps += 1
-            if profiling_until and steps >= profiling_until and jax.process_index() == 0:
-                float(loss_rec_dev)  # real D2H drain — block_until_ready can
-                # return early through a remote-TPU tunnel (see bench.py)
-                profiling.stop_trace()
-                profiling_until = 0
-            if steps % log_every == 0 and jax.process_index() == 0:
-                loss_rec = float(loss_rec_dev)  # the only per-step host sync
-                time_end = time.time()
-                print_log(
-                    f"steps: {steps:8d} loss: {loss_rec:.4f} "
-                    f"time_cost: {time_end - time_start:.2f}", log)
-                time_start = time.time()
-            if max_steps is not None and steps >= max_steps:
-                done = True
+    # the host→device copy of batch n+1 overlaps the compute of batch n —
+    # device_put blocks on the upload RPC on network-attached TPU hosts, so
+    # an unprefetched loop would serialize transfer and compute
+    place = lambda b: shard_batch(b, mesh)  # noqa: E731
+    saver = _AsyncSaver(
+        sync=jax.process_count() > 1 or not config.async_checkpoint)
+    try:
+        for epoch in range(epoch_start, config.epoch[1]):
+            train_loader.set_epoch(epoch)
+            for batch in device_prefetch(train_loader, place):
+                state, _, loss_rec_dev = train_step(
+                    state, batch, step_rng, loss_rec_dev
+                )
+                steps += 1
+                if profiling_until and steps >= profiling_until and jax.process_index() == 0:
+                    float(loss_rec_dev)  # real D2H drain — block_until_ready can
+                    # return early through a remote-TPU tunnel (see bench.py)
+                    profiling.stop_trace()
+                    profiling_until = 0
+                if steps % log_every == 0 and jax.process_index() == 0:
+                    loss_rec = float(loss_rec_dev)  # the only per-step host sync
+                    time_end = time.time()
+                    print_log(
+                        f"steps: {steps:8d} loss: {loss_rec:.4f} "
+                        f"time_cost: {time_end - time_start:.2f}", log)
+                    time_start = time.time()
+                if max_steps is not None and steps >= max_steps:
+                    done = True
+                    break
+            loss_rec = float(loss_rec_dev)
+
+            # -- evaluate: global-mean loss per batch, mean over batches --------
+            # losses stay on device so dispatch pipelines across the val set; the
+            # single float() below is the only host sync (the reference's
+            # loss.item()-per-batch pattern would idle the TPU between batches)
+            test_loader.set_epoch(epoch)
+            batch_losses = [
+                eval_step(state.params, b) for b in device_prefetch(test_loader, place)
+            ]
+            vloss = float(jnp.mean(jnp.stack(batch_losses)))
+
+            if jax.process_index() == 0:
+                print_log(f"epoch: {epoch:4d}    loss: {vloss:.5f}    time:{asctime()}", log)
+                writer.add_scalar("loss", vloss, epoch)
+            # orbax writes of sharded global arrays are collective — EVERY process
+            # calls save_checkpoint (vloss is a global mean, identical on all
+            # hosts, so the branch agrees); only logging and the host-local torch
+            # pkl export stay process-0-gated.
+            saver.wait()  # at most one epoch's saves in flight
+            if saver.sync:
+                # synchronous saves finish before the next (donating) step
+                params_snap, opt_snap = state.params, state.opt_state
+            else:
+                # snapshot on device: the live buffers are donated to the next
+                # train_step, so the async saver must read from its own copy
+                params_snap = jax.tree.map(jnp.copy, state.params)
+                opt_snap = jax.tree.map(jnp.copy, state.opt_state)
+
+            def save_epoch(epoch=epoch, steps=steps, loss_rec=loss_rec,
+                           vloss=vloss, best=best_loss, params=params_snap,
+                           opt_state=opt_snap):
+                if vloss < best:
+                    ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), params)
+                    if jax.process_index() == 0 and _fully_addressable(params):
+                        try:
+                            ckpt.save_torch_pkl(params,
+                                                os.path.join(run_dir, "bestloss.pkl"),
+                                                config.patch_size)
+                        except ImportError:
+                            pass
+                ckpt.save_checkpoint(
+                    os.path.join(run_dir, "lastepoch.ckpt"),
+                    {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
+                     "metric": min(vloss, best), "params": params,
+                     "opt_state": opt_state},
+                )
+
+            best_loss = min(best_loss, vloss)
+            saver.submit(save_epoch)
+            if done:
                 break
-        loss_rec = float(loss_rec_dev)
-
-        # -- evaluate: global-mean loss per batch, mean over batches --------
-        # losses stay on device so dispatch pipelines across the val set; the
-        # single float() below is the only host sync (the reference's
-        # loss.item()-per-batch pattern would idle the TPU between batches)
-        test_loader.set_epoch(epoch)
-        batch_losses = [
-            eval_step(state.params, shard_batch(b, mesh)) for b in test_loader
-        ]
-        vloss = float(jnp.mean(jnp.stack(batch_losses)))
-
-        if jax.process_index() == 0:
-            print_log(f"epoch: {epoch:4d}    loss: {vloss:.5f}    time:{asctime()}", log)
-            writer.add_scalar("loss", vloss, epoch)
-        # orbax writes of sharded global arrays are collective — EVERY process
-        # calls save_checkpoint (vloss is a global mean, identical on all
-        # hosts, so the branch agrees); only logging and the host-local torch
-        # pkl export stay process-0-gated.
-        if vloss < best_loss:
-            best_loss = vloss
-            ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), state.params)
-            if jax.process_index() == 0 and _fully_addressable(state.params):
-                try:
-                    ckpt.save_torch_pkl(state.params,
-                                        os.path.join(run_dir, "bestloss.pkl"),
-                                        config.patch_size)
-                except ImportError:
-                    pass
-        ckpt.save_checkpoint(
-            os.path.join(run_dir, "lastepoch.ckpt"),
-            {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
-             "metric": best_loss, "params": state.params,
-             "opt_state": state.opt_state},
-        )
-        if done:
-            break
-    if profiling_until and jax.process_index() == 0:
-        profiling.stop_trace()  # run ended inside the trace window
-    writer.close()
+    finally:
+        # cleanup first — a save error raised by wait() below must not strand
+        # a running profiler trace or drop buffered scalars
+        if profiling_until and jax.process_index() == 0:
+            profiling.stop_trace()  # run ended inside the trace window
+        writer.close()
+        # an epoch-loop exception must not strand an in-flight checkpoint
+        # write (daemon thread killed at teardown mid-write would corrupt
+        # the only resume point)
+        saver.wait()
     return TrainResult(best_loss=best_loss, last_val_loss=vloss, steps=steps,
                        run_dir=run_dir)
