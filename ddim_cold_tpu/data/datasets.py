@@ -315,7 +315,8 @@ class ColdDownSampleDataset(_BaseCache):
                 noisy[j], target[j], _ = self._pil_item(int(i), ts[j])
         return noisy, target, np.asarray(ts, np.int32)
 
-    def get_raw_batch(self, indices: Sequence[int], num_threads: int = 8):
+    def get_raw_batch(self, indices: Sequence[int], num_threads: int = 8,
+                      pool=None):
         """Device-side-corruption path: ``(base, t)`` — the clean decoded
         bases plus the per-sample steps, with NO host degradation. The jitted
         step rebuilds ``(D(x,t), target, t)`` on device via
@@ -324,18 +325,17 @@ class ColdDownSampleDataset(_BaseCache):
         transfer, not the decode, dominates on network-attached TPU hosts.
 
         ``t`` comes from the same per-(seed, epoch, index) stream as the host
-        path, so both paths train on identical corruption schedules."""
+        path, so both paths train on identical corruption schedules.
+        ``pool`` is the loader's shared ThreadPoolExecutor for the PIL
+        fallback (avoids per-batch executor churn on the hot path)."""
         ts = np.asarray([self._draw_t(int(i)) for i in indices], np.int32)
         base = None
         if self.use_native:
             base = self._bases_for(indices, num_threads)
         if base is None:  # no native decoder → per-item through the cache,
             # fanned over threads like the host path (PIL decode drops the GIL)
-            if num_threads > 1:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(num_threads) as pool:
-                    base = np.stack(list(pool.map(self._base, map(int, indices))))
+            if pool is not None:
+                base = np.stack(list(pool.map(self._base, map(int, indices))))
             else:
                 base = np.stack([self._base(int(i)) for i in indices])
         return base, ts

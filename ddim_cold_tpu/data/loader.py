@@ -116,7 +116,8 @@ class ShardedLoader:
 
     def _make_batch(self, idxs: np.ndarray, pool: Optional[ThreadPoolExecutor] = None):
         if self.raw:  # (base, t) only — corruption happens on device (in-jit)
-            return self.dataset.get_raw_batch(idxs, num_threads=max(1, self.num_threads))
+            return self.dataset.get_raw_batch(
+                idxs, num_threads=max(1, self.num_threads), pool=pool)
         # native fast path: the dataset assembles the whole batch in C++
         # threads (decode/resize/degrade/collate outside the GIL); None means
         # "not available for this batch" → per-item python path.

@@ -194,7 +194,9 @@ def init_variables(rng: jax.Array, dtype=jnp.float32):
     (``load_torch_inception``)."""
     model = InceptionV3Features(dtype=dtype)
     tiny = jnp.zeros((1, INCEPTION_SIZE, INCEPTION_SIZE, 3), dtype)
-    variables = model.init(rng, tiny)
+    # jit: the 94-conv init traced eagerly costs ~20s on CPU; compiled (and
+    # persistently cached) it is sub-second on reruns
+    variables = jax.jit(model.init)(rng, tiny)
 
     def he(tree):
         return {

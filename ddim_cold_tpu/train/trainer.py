@@ -191,7 +191,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     train_set = _build_dataset(config, config.data_storage[0])
     test_set = _build_dataset(config, config.data_storage[1])
     # device-side corruption: cold datasets ship (base, t) and the jitted step
-    # rebuilds (D(x,t), target, t) on device — bit-identical gathers, ~3× less
+    # rebuilds (D(x,t), target, t) on device — bit-identical gathers, 2× less
     # host→device traffic (the dominant per-step cost on tunneled TPU hosts)
     raw_path = config.device_degrade and config.dataset in ("cold", "cold_direct")
     prepare = None
@@ -346,10 +346,16 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                 params_snap = jax.tree.map(jnp.copy, state.params)
                 opt_snap = jax.tree.map(jnp.copy, state.opt_state)
 
+            # NaN-safe: a diverged epoch (vloss NaN) compares False and leaves
+            # best_loss finite — min() would store NaN and poison resume
+            improved = vloss < best_loss
+            if improved:
+                best_loss = vloss
+
             def save_epoch(epoch=epoch, steps=steps, loss_rec=loss_rec,
-                           vloss=vloss, best=best_loss, params=params_snap,
-                           opt_state=opt_snap):
-                if vloss < best:
+                           improved=improved, best=best_loss,
+                           params=params_snap, opt_state=opt_snap):
+                if improved:
                     ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), params)
                     if jax.process_index() == 0 and _fully_addressable(params):
                         try:
@@ -361,11 +367,10 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                 ckpt.save_checkpoint(
                     os.path.join(run_dir, "lastepoch.ckpt"),
                     {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
-                     "metric": min(vloss, best), "params": params,
+                     "metric": best, "params": params,
                      "opt_state": opt_state},
                 )
 
-            best_loss = min(best_loss, vloss)
             saver.submit(save_epoch)
             if done:
                 break

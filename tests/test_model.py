@@ -219,11 +219,11 @@ def test_remat_matches_plain(tiny_model_and_params):
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
     t = jnp.array([3, 1500], dtype=jnp.int32)
 
-    rparams = rmodel.init(jax.random.PRNGKey(0), x, t)["params"]
+    rparams = jax.jit(rmodel.init)(jax.random.PRNGKey(0), x, t)["params"]
     assert jax.tree.structure(params) == jax.tree.structure(rparams)
 
-    out = model.apply({"params": params}, x, t)
-    rout = rmodel.apply({"params": params}, x, t)
+    out = jax.jit(model.apply)({"params": params}, x, t)
+    rout = jax.jit(rmodel.apply)({"params": params}, x, t)
     np.testing.assert_allclose(np.asarray(out), np.asarray(rout), atol=1e-6)
 
     def loss(m, p):
@@ -231,8 +231,8 @@ def test_remat_matches_plain(tiny_model_and_params):
         y = m.apply({"params": p}, x, t, deterministic=False, rngs={"dropout": drng})
         return jnp.mean(y**2)
 
-    g = jax.grad(lambda p: loss(model, p))(params)
-    rg = jax.grad(lambda p: loss(rmodel, p))(params)
+    g = jax.jit(jax.grad(lambda p: loss(model, p)))(params)
+    rg = jax.jit(jax.grad(lambda p: loss(rmodel, p)))(params)
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(rg)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 

@@ -21,7 +21,7 @@ def scanned_model_and_params():
     model = DiffusionViT(scan_blocks=True, **CFG)
     x = jnp.asarray(np.random.RandomState(0).randn(8, 16, 16, 3), jnp.float32)
     t = jnp.array([1, 5, 9, 100, 400, 1999, 0, 7], jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), x, t)["params"]
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), x, t)["params"]
     return model, params, x, t
 
 
@@ -35,8 +35,8 @@ def test_pipelined_forward_matches_scanned(scanned_model_and_params, mesh_shape,
     n_dev = int(np.prod(list(mesh_shape.values())))
     mesh = make_mesh(mesh_shape, devices=jax.devices()[:n_dev])
     pf = make_pipelined_apply(model, mesh, n_microbatch=n_micro)
-    want = np.asarray(model.apply({"params": params}, x, t))
-    got = np.asarray(pf({"params": params}, x, t))
+    want = np.asarray(jax.jit(model.apply)({"params": params}, x, t))
+    got = np.asarray(jax.jit(pf)({"params": params}, x, t))
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
@@ -45,8 +45,12 @@ def test_pipelined_grads_match(scanned_model_and_params):
     mesh = make_mesh({"data": 2, "pipe": 4})
     pf = make_pipelined_apply(model, mesh, n_microbatch=4)
 
-    ga = jax.grad(lambda p: jnp.mean(model.apply({"params": p}, x, t) ** 2))(params)
-    gb = jax.grad(lambda p: jnp.mean(pf({"params": p}, x, t) ** 2))(params)
+    # jit the grads: eager transform dispatch on the 8-device CPU mesh is the
+    # suite's single slowest test otherwise (~30s vs ~8s)
+    ga = jax.jit(jax.grad(
+        lambda p: jnp.mean(model.apply({"params": p}, x, t) ** 2)))(params)
+    gb = jax.jit(jax.grad(
+        lambda p: jnp.mean(pf({"params": p}, x, t) ** 2)))(params)
     for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
@@ -55,8 +59,9 @@ def test_pipelined_training_mode_finite(scanned_model_and_params):
     model, params, x, t = scanned_model_and_params
     mesh = make_mesh({"data": 2, "pipe": 4})
     pf = make_pipelined_apply(model, mesh, n_microbatch=2)
-    y = pf({"params": params}, x, t, deterministic=False,
-           rngs={"dropout": jax.random.PRNGKey(3)})
+    y = jax.jit(lambda p, x, t: pf(
+        {"params": p}, x, t, deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(3)}))(params, x, t)
     assert bool(jnp.isfinite(y).all())
 
 
@@ -130,8 +135,9 @@ def test_pipelined_dropout_independent_across_data_shards(scanned_model_and_para
         jnp.asarray(np.random.RandomState(6).randn(1, 16, 16, 3), jnp.float32),
         (8, 16, 16, 3))
     t = jnp.full((8,), 42, jnp.int32)
-    y = np.asarray(pf({"params": params}, x, t, deterministic=False,
-                      rngs={"dropout": jax.random.PRNGKey(11)}))
+    y = np.asarray(jax.jit(lambda p, x, t: pf(
+        {"params": p}, x, t, deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(11)}))(params, x, t))
     # rows 0..3 live on data shard 0, rows 4..7 on shard 1; same position in
     # each shard must NOT be identical
     assert not np.allclose(y[0], y[4])

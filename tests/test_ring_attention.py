@@ -29,7 +29,9 @@ def test_ring_matches_dense(N):
     scale = D**-0.5
     mesh = make_mesh({"data": 8, "model": 1})
     want = np.asarray(dense_attention(q, k, v, scale))
-    got = np.asarray(ring_self_attention(q, k, v, mesh, axis="data", scale=scale))
+    ring = jax.jit(lambda q, k, v: ring_self_attention(  # jit: eager shard_map
+        q, k, v, mesh, axis="data", scale=scale))       # dispatch is ~10× slower
+    got = np.asarray(ring(q, k, v))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
@@ -40,7 +42,7 @@ def test_ring_bf16_inputs():
     k = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
     v = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
     mesh = make_mesh({"data": 8, "model": 1})
-    out = ring_self_attention(q, k, v, mesh)
+    out = jax.jit(lambda q, k, v: ring_self_attention(q, k, v, mesh))(q, k, v)
     assert out.dtype == jnp.bfloat16 and out.shape == (B, N, H, D)
     want = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
                            v.astype(jnp.float32), 8**-0.5)
@@ -66,7 +68,8 @@ def test_ring_composed_batch_axis():
     B, N, H, D = 4, 33, 2, 8
     q, k, v = (jnp.asarray(rng.randn(B, N, H, D), jnp.float32) for _ in range(3))
     mesh = make_mesh({"data": 2, "seq": 4})
-    got = np.asarray(ring_self_attention(q, k, v, mesh, axis="seq", batch_axis="data"))
+    got = np.asarray(jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh, axis="seq", batch_axis="data"))(q, k, v))
     want = np.asarray(dense_attention(q, k, v, D**-0.5))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
@@ -82,11 +85,11 @@ def test_model_with_seq_parallel_matches_dense():
     ringed = DiffusionViT(seq_mesh=mesh, seq_axis="seq", batch_axis="data", **cfg)
     x = jnp.asarray(np.random.RandomState(4).randn(4, 16, 16, 3), jnp.float32)
     t = jnp.array([0, 5, 100, 1999], jnp.int32)
-    params = plain.init(jax.random.PRNGKey(0), x, t)["params"]
-    rparams = ringed.init(jax.random.PRNGKey(0), x, t)["params"]
+    params = jax.jit(plain.init)(jax.random.PRNGKey(0), x, t)["params"]
+    rparams = jax.jit(ringed.init)(jax.random.PRNGKey(0), x, t)["params"]
     assert jax.tree.structure(params) == jax.tree.structure(rparams)
-    a = np.asarray(plain.apply({"params": params}, x, t))
-    b = np.asarray(ringed.apply({"params": params}, x, t))
+    a = np.asarray(jax.jit(plain.apply)({"params": params}, x, t))
+    b = np.asarray(jax.jit(ringed.apply)({"params": params}, x, t))
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
 
 
@@ -137,10 +140,10 @@ def test_seq_parallel_head_axis_and_dropout_guard():
                            head_axis="model", attn_drop_rate=0.0, **cfg)
     x = jnp.asarray(np.random.RandomState(5).randn(2, 16, 16, 3), jnp.float32)
     t = jnp.array([1, 2], jnp.int32)
-    params = sharded.init(jax.random.PRNGKey(0), x, t)["params"]
+    params = jax.jit(sharded.init)(jax.random.PRNGKey(0), x, t)["params"]
     plain = DiffusionViT(**cfg)
-    a = np.asarray(plain.apply({"params": params}, x, t))
-    b = np.asarray(sharded.apply({"params": params}, x, t))
+    a = np.asarray(jax.jit(plain.apply)({"params": params}, x, t))
+    b = np.asarray(jax.jit(sharded.apply)({"params": params}, x, t))
     np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
 
     bad = DiffusionViT(seq_mesh=mesh, seq_axis="seq", batch_axis="data", **cfg)

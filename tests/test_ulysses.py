@@ -70,11 +70,11 @@ def test_model_sp_mode_ulysses_matches_dense_model():
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3))
     t = jnp.array([3, 500], jnp.int32)
     base = DiffusionViT(**cfg)
-    params = base.init(jax.random.PRNGKey(1), x, t)["params"]
+    params = jax.jit(base.init)(jax.random.PRNGKey(1), x, t)["params"]
     sp = DiffusionViT(seq_mesh=mesh, seq_axis="seq", batch_axis="data",
                       sp_mode="ulysses", attn_drop_rate=0.0, **cfg)
-    out_base = base.apply({"params": params}, x, t)
-    out_sp = sp.apply({"params": params}, x, t)
+    out_base = jax.jit(base.apply)({"params": params}, x, t)
+    out_sp = jax.jit(sp.apply)({"params": params}, x, t)
     np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_base),
                                rtol=2e-4, atol=2e-5)
 
