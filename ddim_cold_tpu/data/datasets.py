@@ -49,15 +49,39 @@ _cache_lock = threading.Lock()
 class _BaseCache:
     """Decoded-base-image cache shared by both dataset classes.
 
-    Stores float32 HWC [−1,1] arrays keyed by index. Concurrent ``__getitem__``
-    calls may race on a miss — both decode, one write wins; contents are
-    identical either way (native and PIL paths are bit-exact, tests/test_native).
+    Entries are keyed by index and stored RAW-preferred: uint8 RGB when the
+    file decodes at exactly ``img_size`` (no resize — 4× less RAM, and the
+    uint8 transfer path ships these bytes straight to the device), float32
+    HWC [−1,1] otherwise. ``_normalize`` converts on read with the exact
+    host-pipeline op order, so both storage forms are interchangeable.
+    Concurrent ``__getitem__`` calls may race on a miss — both decode, one
+    write wins; contents are identical either way (native and PIL paths are
+    bit-exact, tests/test_native).
     """
+
+    def _probe_uniform_u8(self) -> bool:
+        """Header-only size scan (no pixel decode): True when EVERY file's
+        native size equals img_size, i.e. raw uint8 storage/transfer applies.
+        The decision is per-dataset, never per-batch — batch dtype must be
+        stable across batches and across SPMD hosts (every host lists the
+        same sorted files, so every host decides identically)."""
+        want = (int(self.img_size[1]), int(self.img_size[0]))  # PIL is (w, h)
+        try:
+            for name in self.imgList:
+                with Image.open(os.path.join(self.root, name)) as im:
+                    if im.size != want:
+                        return False
+        except Exception:
+            return False
+        return True
 
     def _init_cache(self, cache_images: Optional[bool], n_items: int,
                     img_size: Sequence[int]) -> None:
         global _cache_reserved
-        est = n_items * int(img_size[0]) * int(img_size[1]) * 3 * 4
+        self._uniform_u8 = self._probe_uniform_u8()
+        # uint8 entries are 4× smaller — let the auto budget see that
+        est = n_items * int(img_size[0]) * int(img_size[1]) * 3 * (
+            1 if self._uniform_u8 else 4)
         if cache_images is None:
             # budget is process-wide: train + val datasets both auto-enabling
             # must together stay under CACHE_BUDGET_BYTES
@@ -82,39 +106,90 @@ class _BaseCache:
             except Exception:  # interpreter teardown: globals may be gone
                 pass
 
+    @staticmethod
+    def _normalize(entry: np.ndarray) -> np.ndarray:
+        """uint8 entry → float32 [−1,1] with the exact ``_load_base`` op order
+        (÷255 then ·2−1); float entries pass through."""
+        if entry.dtype == np.uint8:
+            return (entry.astype(np.float32) / 255.0) * 2.0 - 1.0
+        return entry
+
+    def _load_raw(self, path: str) -> np.ndarray:
+        """One file, raw-preferred: uint8 when it decodes at exactly img_size,
+        else the float [−1,1] resize pipeline."""
+        img = pil_loader(path)
+        if (img.height, img.width) == tuple(self.img_size):
+            return np.asarray(img, dtype=np.uint8)
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        return resize.resize_bilinear(arr, tuple(self.img_size)) * 2.0 - 1.0
+
     def _base(self, index: int) -> np.ndarray:
-        """Decoded+resized base image for one item, through the cache."""
+        """Decoded+resized float32 base image for one item, through the cache."""
         hit = self._cache.get(index) if self.cache_images else None
         if hit is not None:
-            return hit
+            return self._normalize(hit)
+        if self.use_native:
+            raw = self._raw_entries([index], num_threads=1)
+            return self._normalize(raw[0])
         img = _load_base(os.path.join(self.root, self.imgList[index]),
-                         self.img_size, self.use_native)
+                         self.img_size, use_native=False)
         if self.cache_images:
             self._cache[index] = img
         return img
 
-    def _bases_for(self, indices: Sequence[int], num_threads: int):
-        """Batch path: fill cache misses with one native C++ threaded decode
-        (PIL repair per failed slot), then return the stacked bases — or None
-        when native can't decode the missing files (caller falls back)."""
+    def _raw_entries(self, indices: Sequence[int], num_threads: int,
+                     pool=None) -> list[np.ndarray]:
+        """Cache entries (u8 or f32, see class docstring) for a batch.
+
+        Misses fill in three tiers: raw C++ u8 decode (exact-size files) →
+        fused C++ f32 decode+resize (size-mismatched files) → PIL per item
+        (formats native rejects), fanned over ``pool`` when provided.
+        """
         missing = ([i for i in indices if int(i) not in self._cache]
                    if self.cache_images else list(indices))
+        got: dict[int, np.ndarray] = {}
         if missing:
             paths = [os.path.join(self.root, self.imgList[int(i)]) for i in missing]
-            res = native.base_batch(paths, self.img_size, num_threads=num_threads)
-            if res is None:
-                return None
-            base, failed = res
-            if failed.all():
-                return None
-            for j, i in enumerate(missing):
-                if failed[j]:
-                    base[j] = _load_base(paths[j], self.img_size, use_native=False)
-                if self.cache_images:
-                    self._cache[int(i)] = base[j]
-            if not self.cache_images:
-                return base
-        return np.stack([self._cache[int(i)] for i in indices])
+            if self._uniform_u8:  # gated by the header probe — a dataset that
+                # needs resizing must not pay a doomed full decode here
+                res = native.decode_batch(paths, self.img_size,
+                                          num_threads=num_threads)
+                if res is not None:
+                    u8, failed = res
+                    for j, i in enumerate(missing):
+                        if not failed[j]:
+                            got[int(i)] = u8[j]
+            left = [(j, int(i)) for j, i in enumerate(missing) if int(i) not in got]
+            if left:
+                res = native.base_batch([paths[j] for j, _ in left],
+                                        self.img_size, num_threads=num_threads)
+                if res is not None:
+                    f32, failed = res
+                    for k, (_, i) in enumerate(left):
+                        if not failed[k]:
+                            got[i] = f32[k]
+                left = [(j, i) for j, i in left if i not in got]
+            if left:  # formats native rejects (webp/alpha-png/…) → PIL
+                mapper = pool.map if pool is not None else map
+                for (j, i), entry in zip(
+                    left, mapper(self._load_raw, [paths[j] for j, _ in left])
+                ):
+                    got[i] = entry
+            if self.cache_images:
+                # .copy(): u8[j]/f32[k] are views into the batch buffers —
+                # caching views would pin the whole buffer per entry
+                self._cache.update({k: v.copy() for k, v in got.items()})
+        if self.cache_images:
+            return [self._cache[int(i)] for i in indices]
+        return [got[int(i)] for i in indices]  # no cache → all were missing
+
+    def _bases_for(self, indices: Sequence[int], num_threads: int,
+                   pool=None) -> np.ndarray:
+        """Batch of float32 [−1,1] bases (the host-degrade contract)."""
+        return np.stack([
+            self._normalize(e)
+            for e in self._raw_entries(indices, num_threads, pool=pool)
+        ])
 
 
 def pil_loader(path: str) -> Image.Image:
@@ -195,16 +270,16 @@ class DiffusionDataset(_BaseCache):
         t, noisy = self._noise_for(index, img, t)
         return noisy, img.astype(np.float32), t
 
-    def get_batch(self, indices: Sequence[int], num_threads: int = 8):
+    def get_batch(self, indices: Sequence[int], num_threads: int = 8,
+                  pool=None):
         """Batch fast path: decode+resize in C++ threads (through the cache),
         noise in numpy. Returns collated ``(noisy, target, t)`` arrays, or
-        None to make the loader fall back to per-item assembly (e.g. a
-        webp/bmp dataset native can't decode)."""
+        None to make the loader fall back to per-item assembly.
+        ``pool`` fans the PIL tier (formats native rejects) over the loader's
+        shared executor."""
         if not self.use_native:
             return None
-        base = self._bases_for(indices, num_threads)
-        if base is None:
-            return None
+        base = self._bases_for(indices, num_threads, pool=pool)
         noisy = np.empty_like(base)
         ts = np.empty(len(base), np.int32)
         for j, i in enumerate(indices):
@@ -281,18 +356,18 @@ class ColdDownSampleDataset(_BaseCache):
                 return res[0], res[1], t
         return self._pil_item(index, t)
 
-    def get_batch(self, indices: Sequence[int], num_threads: int = 8):
+    def get_batch(self, indices: Sequence[int], num_threads: int = 8,
+                  pool=None):
         """Batch fast path: the whole (decode, resize, degrade, collate)
         pipeline in C++ threads (decode through the cache when enabled);
         failed slots redone via PIL with the same t. Returns
-        ``(noisy, target, t)`` or None (→ loader per-item path)."""
+        ``(noisy, target, t)`` or None (→ loader per-item path).
+        ``pool`` fans the PIL tier over the loader's shared executor."""
         if not self.use_native:
             return None
         ts = [self._draw_t(int(i)) for i in indices]
         if self.cache_images:
-            base = self._bases_for(indices, num_threads)
-            if base is None:
-                return None
+            base = self._bases_for(indices, num_threads, pool=pool)
             pair = native.cold_pair_batch(base, ts, self.target_mode == "chain",
                                           num_threads=num_threads)
             if pair is not None:
@@ -327,17 +402,24 @@ class ColdDownSampleDataset(_BaseCache):
         ``t`` comes from the same per-(seed, epoch, index) stream as the host
         path, so both paths train on identical corruption schedules.
         ``pool`` is the loader's shared ThreadPoolExecutor for the PIL
-        fallback (avoids per-batch executor churn on the hot path)."""
+        fallback (avoids per-batch executor churn on the hot path).
+
+        When every base decodes at exactly img_size the batch ships as raw
+        **uint8** (4× less host→device traffic than float32; the in-jit
+        ``normalize_base`` conversion is bit-exact), else float32."""
         ts = np.asarray([self._draw_t(int(i)) for i in indices], np.int32)
-        base = None
         if self.use_native:
-            base = self._bases_for(indices, num_threads)
-        if base is None:  # no native decoder → per-item through the cache,
-            # fanned over threads like the host path (PIL decode drops the GIL)
-            if pool is not None:
-                base = np.stack(list(pool.map(self._base, map(int, indices))))
-            else:
-                base = np.stack([self._base(int(i)) for i in indices])
+            entries = self._raw_entries(indices, num_threads, pool=pool)
+        else:  # per-item through the cache, fanned over the loader's pool
+            mapper = pool.map if pool is not None else map
+            entries = list(mapper(self._base, map(int, indices)))
+        # dtype is pinned per-DATASET (_uniform_u8), never per-batch: batches
+        # must agree across epochs and across SPMD hosts or the jitted step
+        # retraces / make_array_from_process_local_data gets mixed dtypes
+        if self._uniform_u8 and all(e.dtype == np.uint8 for e in entries):
+            base = np.stack(entries)
+        else:
+            base = np.stack([self._normalize(e) for e in entries])
         return base, ts
 
     def _pil_item(self, index: int, t: int):

@@ -123,7 +123,7 @@ class ShardedLoader:
         # "not available for this batch" → per-item python path.
         get_batch = getattr(self.dataset, "get_batch", None)
         if get_batch is not None:
-            batch = get_batch(idxs, num_threads=max(1, self.num_threads))
+            batch = get_batch(idxs, num_threads=max(1, self.num_threads), pool=pool)
             if batch is not None:
                 return batch
         if pool is None:

@@ -106,6 +106,14 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.ddim_cold_pair_batch.restype = None
         except AttributeError:  # stale .so from before this entry point
             pass
+        try:
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.ddim_decode_batch.argtypes = [charpp, ctypes.c_int, ctypes.c_int,
+                                              ctypes.c_int, ctypes.c_int, u8p,
+                                              i32p]
+            lib.ddim_decode_batch.restype = ctypes.c_int
+        except AttributeError:  # stale .so from before this entry point
+            pass
         _lib = lib
         return _lib
 
@@ -208,6 +216,27 @@ def cold_pair_batch(bases: np.ndarray, ts: Sequence[int], chain: bool,
         n, size, int(chain), int(num_threads), _f32(noisy), _f32(target),
     )
     return noisy, target
+
+
+def decode_batch(paths: Sequence[str], out_hw: tuple[int, int], num_threads: int = 8):
+    """Raw RGB8 batch for the uint8 transfer path: a slot succeeds only when
+    the file decodes at exactly ``out_hw`` (no resize — the bytes are the
+    pre-normalization pixels). Returns ``(u8_batch, failed_mask)`` or None
+    when the library (or entry point) is unavailable; failed slots go through
+    the float path."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ddim_decode_batch"):
+        return None
+    n = len(paths)
+    h, w = out_hw
+    out = np.empty((n, h, w, 3), np.uint8)
+    failed = np.zeros(n, np.int32)
+    lib.ddim_decode_batch(
+        _paths_array(paths), n, h, w, int(num_threads),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out, failed.astype(bool)
 
 
 def base_batch(paths: Sequence[str], out_hw: tuple[int, int], num_threads: int = 8):

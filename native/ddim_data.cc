@@ -367,4 +367,28 @@ int ddim_base_batch(const char** paths, int n, int out_h, int out_w,
   });
 }
 
+// Batch of RAW RGB8 decodes for the uint8 transfer path: a slot succeeds only
+// when the file decodes AND its native size is exactly (out_h, out_w) — no
+// resize happens here, so the bytes are the exact pre-normalization pixels
+// and (u8/255)·2−1 on device reproduces load_base bit-for-bit. Size-mismatch
+// or decode-error slots set `failed` and the Python side falls back to the
+// float path (which resizes).
+int ddim_decode_batch(const char** paths, int n, int out_h, int out_w,
+                      int num_threads, uint8_t* out, int32_t* failed) {
+  const size_t stride = static_cast<size_t>(out_h) * out_w * 3;
+  if (failed) std::memset(failed, 0, sizeof(int32_t) * n);
+  return parallel_items(n, num_threads, [&](int i) -> int {
+    int h = 0, w = 0;
+    uint8_t* buf = decode_rgb8(paths[i], &h, &w);
+    int rc = 1;
+    if (buf && h == out_h && w == out_w) {
+      std::memcpy(out + stride * i, buf, stride);
+      rc = 0;
+    }
+    std::free(buf);
+    if (rc && failed) failed[i] = 1;
+    return rc;
+  });
+}
+
 }  // extern "C"

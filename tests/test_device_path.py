@@ -66,6 +66,88 @@ def test_uint8_base_normalizes_bitexact(rng):
     np.testing.assert_array_equal(np.asarray(degrade.normalize_base(jnp.asarray(f))), f)
 
 
+@pytest.fixture(scope="module")
+def exact_size_image_dir(tmp_path_factory):
+    """jpgs whose native size IS the dataset img_size (64×64) — the uint8
+    ship-raw-bytes fast path (no resize anywhere)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("exact64_jpgs")
+    rs = np.random.RandomState(7)
+    for i in range(8):
+        arr = rs.randint(0, 255, size=(64, 64, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / f"{i}.jpg")
+    return str(root)
+
+
+def test_raw_batch_ships_uint8_when_exact_size(exact_size_image_dir):
+    """Identity-resize datasets ship raw uint8 bytes (4× less transfer), and
+    the in-jit normalize+degrade rebuilds the host batch bit-exactly."""
+    mk = lambda: ColdDownSampleDataset(  # noqa: E731
+        exact_size_image_dir, imgSize=(64, 64), target_mode="chain")
+    raw_ds, host_ds = mk(), mk()
+    idxs = np.arange(8)
+    base, ts = raw_ds.get_raw_batch(idxs, num_threads=2)
+    assert base.dtype == np.uint8, "exact-size files must ship as uint8"
+    noisy, target, host_ts = host_ds.get_batch(idxs, num_threads=2)
+    np.testing.assert_array_equal(ts, host_ts)
+    prepare = degrade.make_cold_prepare(size=64, max_step=6, chain=True)
+    d_noisy, d_target, _ = prepare(
+        (jnp.asarray(base), jnp.asarray(ts)), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(d_noisy), noisy)
+    np.testing.assert_array_equal(np.asarray(d_target), target)
+    # the float32 view through the same cache matches the PIL pipeline
+    from ddim_cold_tpu.data.datasets import _load_base
+    import os
+
+    want = _load_base(os.path.join(exact_size_image_dir,
+                                   sorted(os.listdir(exact_size_image_dir))[0]),
+                      (64, 64), use_native=False)
+    np.testing.assert_array_equal(raw_ds._base(0), want)
+
+
+def test_raw_dtype_stable_for_mixed_size_dataset(tmp_path):
+    """One off-size file pins the WHOLE dataset to float32 — batch dtype must
+    not flip with batch composition (jit retraces; multi-host SPMD hosts must
+    agree on the global array dtype)."""
+    from PIL import Image
+
+    rs = np.random.RandomState(3)
+    for i in range(6):
+        Image.fromarray(rs.randint(0, 255, (64, 64, 3), np.uint8)).save(
+            tmp_path / f"exact_{i}.jpg")
+    Image.fromarray(rs.randint(0, 255, (65, 64, 3), np.uint8)).save(
+        tmp_path / "odd.jpg")
+    ds = ColdDownSampleDataset(str(tmp_path), imgSize=(64, 64))
+    assert not ds._uniform_u8
+    # a batch containing ONLY exact-size files still ships float32
+    base, _ = ds.get_raw_batch([0, 1, 2], num_threads=1)
+    assert base.dtype == np.float32
+
+
+def test_native_decode_batch_parity(exact_size_image_dir):
+    """Raw C++ u8 decode == PIL bytes; size-mismatched files flag failed."""
+    import os
+
+    from PIL import Image
+
+    from ddim_cold_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    paths = [os.path.join(exact_size_image_dir, n)
+             for n in sorted(os.listdir(exact_size_image_dir))]
+    res = native.decode_batch(paths, (64, 64), num_threads=2)
+    assert res is not None
+    u8, failed = res
+    assert not failed.any()
+    for j, p in enumerate(paths[:3]):
+        np.testing.assert_array_equal(u8[j], np.asarray(Image.open(p).convert("RGB")))
+    # wrong expected size → failed mask, no crash
+    res = native.decode_batch(paths[:2], (32, 32), num_threads=1)
+    assert res is not None and res[1].all()
+
+
 def test_loader_raw_mode_yields_pairs(cold_sets):
     _, raw_ds, _ = cold_sets
     loader = ShardedLoader(raw_ds, 4, shuffle=False, drop_last=True, raw=True)

@@ -130,13 +130,21 @@ def test_loader_uses_native_batches(mixed_image_dir):
 
 
 def test_env_kill_switch(monkeypatch, synthetic_image_dir):
-    """DDIM_COLD_NO_NATIVE force-disables the library for new loads."""
+    """DDIM_COLD_NO_NATIVE force-disables the library; the batch path then
+    degrades to the PIL tier inline with identical bytes."""
     monkeypatch.setattr(native, "_lib", None)
     monkeypatch.setattr(native, "_lib_failed", False)
     monkeypatch.setenv("DDIM_COLD_NO_NATIVE", "1")
     assert not native.available()
+    assert native.decode_batch(["x.jpg"], (8, 8)) is None
     ds = DiffusionDataset(synthetic_image_dir, (32, 32))
-    assert ds.get_batch([0, 1]) is None  # → loader per-item path
+    got = ds.get_batch([0, 1])
+    assert got is not None  # PIL tier fills the batch when the lib is off
+    pil_ds = DiffusionDataset(synthetic_image_dir, (32, 32), use_native=False)
+    items = [pil_ds[0], pil_ds[1]]
+    np.testing.assert_array_equal(got[0], np.stack([it[0] for it in items]))
+    np.testing.assert_array_equal(got[1], np.stack([it[1] for it in items]))
+    np.testing.assert_array_equal(got[2], np.asarray([it[2] for it in items]))
     monkeypatch.setattr(native, "_lib", None)
     monkeypatch.setattr(native, "_lib_failed", False)
 
