@@ -30,6 +30,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 import numpy as np
@@ -42,6 +43,9 @@ _IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
 #: auto-enable the decoded-image cache while all caching datasets in the
 #: process together fit in this budget (train + val both auto-enable)
 CACHE_BUDGET_BYTES = 2 << 30
+#: skip the uint8 header probe (→ float32 mode) above this many files — the
+#: per-file header open would dominate startup on huge/network datasets
+U8_PROBE_MAX_FILES = 100_000
 _cache_reserved = 0
 _cache_lock = threading.Lock()
 
@@ -62,18 +66,38 @@ class _BaseCache:
     def _probe_uniform_u8(self) -> bool:
         """Header-only size scan (no pixel decode): True when EVERY file's
         native size equals img_size, i.e. raw uint8 storage/transfer applies.
+
         The decision is per-dataset, never per-batch — batch dtype must be
         stable across batches and across SPMD hosts (every host lists the
-        same sorted files, so every host decides identically)."""
-        want = (int(self.img_size[1]), int(self.img_size[0]))  # PIL is (w, h)
-        try:
-            for name in self.imgList:
-                with Image.open(os.path.join(self.root, name)) as im:
-                    if im.size != want:
-                        return False
-        except Exception:
+        same sorted files AND checks the same native capability, so every
+        host with an identical build decides identically). u8 entries only
+        ever come from the native decode tier, so the mode requires the
+        ``ddim_decode_batch`` entry point — a stale .so forces float32
+        everywhere rather than diverging from the budget estimate.
+
+        Cost control: the first header short-circuits resize-needed datasets
+        instantly; homogeneous datasets scan the rest over a thread pool;
+        above U8_PROBE_MAX_FILES the probe is skipped (float32 mode) so a
+        million-file dataset never serializes header reads into startup."""
+        if not (self.use_native and native.has_decode_batch()):
             return False
-        return True
+        if len(self.imgList) > U8_PROBE_MAX_FILES:
+            return False
+        want = (int(self.img_size[1]), int(self.img_size[0]))  # PIL is (w, h)
+
+        def ok(name: str) -> bool:
+            try:
+                with Image.open(os.path.join(self.root, name)) as im:
+                    return im.size == want
+            except Exception:
+                return False
+
+        if not ok(self.imgList[0]):
+            return False
+        if len(self.imgList) == 1:
+            return True
+        with ThreadPoolExecutor(8) as pool:
+            return all(pool.map(ok, self.imgList[1:]))
 
     def _init_cache(self, cache_images: Optional[bool], n_items: int,
                     img_size: Sequence[int]) -> None:

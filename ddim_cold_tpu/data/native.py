@@ -123,6 +123,15 @@ def available() -> bool:
     return _load() is not None
 
 
+def has_decode_batch() -> bool:
+    """True when the raw-u8 decode entry point exists (a stale .so built
+    before it would silently force the float path — callers gate the uint8
+    transfer mode on this so dtype never depends on which tier happened to
+    fill a batch)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "ddim_decode_batch")
+
+
 def supports(path: str) -> bool:
     return os.path.splitext(path)[1].lower() in NATIVE_EXTS
 
