@@ -74,10 +74,10 @@ class ExperimentConfig:
     profile_steps: int = 0  # trace this many early steps into <run_dir>/trace
     nan_checks: bool = False  # jax_debug_nans for the whole run
     cache_images: object = None  # None=auto (fits 2GB), True/False=force
-    # device-side corruption (cold datasets): ship (base, t), degrade in-jit.
-    # Bit-identical to the host path (gather op, tests/test_device_path.py)
-    # and 2× less host→device traffic (one float image instead of the two
-    # degraded copies); False forces the host/C++ pipeline.
+    # device-side corruption: ship clean bases, corrupt in-jit. Cold datasets:
+    # bit-identical gathers (tests/test_device_path.py), both loaders.
+    # Gaussian: device-drawn ε, train loader only (val stays host-exact).
+    # 2-8× less host→device traffic; False forces the host/C++ pipeline.
     device_degrade: bool = True
     # overlap epoch-end checkpoint writes with the next epoch's compute (costs
     # one transient on-device params+opt_state copy); multi-host runs are

@@ -94,10 +94,13 @@ class _BaseCache:
 
         if not ok(self.imgList[0]):
             return False
-        if len(self.imgList) == 1:
-            return True
+        # chunked scan: a mismatch bails after its chunk — an eager full
+        # pool.map would submit (and then wait out) every remaining open
         with ThreadPoolExecutor(8) as pool:
-            return all(pool.map(ok, self.imgList[1:]))
+            for lo in range(1, len(self.imgList), 1024):
+                if not all(pool.map(ok, self.imgList[lo:lo + 1024])):
+                    return False
+        return True
 
     def _init_cache(self, cache_images: Optional[bool], n_items: int,
                     img_size: Sequence[int]) -> None:
@@ -184,7 +187,10 @@ class _BaseCache:
                         if not failed[j]:
                             got[int(i)] = u8[j]
             left = [(j, int(i)) for j, i in enumerate(missing) if int(i) not in got]
-            if left:
+            if left and not self._uniform_u8:
+                # f32 fused decode+resize — NEVER under u8 mode: a runtime
+                # decode failure must not flip the pinned batch dtype (PIL
+                # below returns u8 for exact-size files, keeping the invariant)
                 res = native.base_batch([paths[j] for j, _ in left],
                                         self.img_size, num_threads=num_threads)
                 if res is not None:
@@ -193,7 +199,7 @@ class _BaseCache:
                         if not failed[k]:
                             got[i] = f32[k]
                 left = [(j, i) for j, i in left if i not in got]
-            if left:  # formats native rejects (webp/alpha-png/…) → PIL
+            if left:  # formats native rejects (progressive jpg/webp/…) → PIL
                 mapper = pool.map if pool is not None else map
                 for (j, i), entry in zip(
                     left, mapper(self._load_raw, [paths[j] for j, _ in left])
@@ -206,6 +212,21 @@ class _BaseCache:
         if self.cache_images:
             return [self._cache[int(i)] for i in indices]
         return [got[int(i)] for i in indices]  # no cache → all were missing
+
+    def _raw_bases(self, indices: Sequence[int], num_threads: int,
+                   pool=None) -> np.ndarray:
+        """Stacked bases for the device-corruption path, dtype pinned
+        per-DATASET (_uniform_u8): uint8 raw bytes for uniform datasets,
+        float32 [−1,1] otherwise. The single place the pinning is enforced —
+        both datasets' get_raw_batch delegate here."""
+        if self.use_native:
+            entries = self._raw_entries(indices, num_threads, pool=pool)
+        else:  # per-item through the cache, fanned over the loader's pool
+            mapper = pool.map if pool is not None else map
+            entries = list(mapper(self._base, map(int, indices)))
+        if self._uniform_u8 and all(e.dtype == np.uint8 for e in entries):
+            return np.stack(entries)
+        return np.stack([self._normalize(e) for e in entries])
 
     def _bases_for(self, indices: Sequence[int], num_threads: int,
                    pool=None) -> np.ndarray:
@@ -293,6 +314,18 @@ class DiffusionDataset(_BaseCache):
         img = self._base(index)
         t, noisy = self._noise_for(index, img, t)
         return noisy, img.astype(np.float32), t
+
+    def get_raw_batch(self, indices: Sequence[int], num_threads: int = 8,
+                      pool=None):
+        """Device-side-corruption path: ``(x₀, t)`` — clean bases (uint8 when
+        the dataset is uniform at img_size, see _BaseCache) plus per-sample
+        steps from the SAME Philox stream as the host path (t is drawn before
+        the noise there, so schedules agree). The forward noising happens
+        in-jit (ops/degrade.make_gaussian_prepare) with device-drawn ε."""
+        ts = np.empty(len(indices), np.int32)
+        for j, i in enumerate(indices):
+            ts[j] = int(self._rng(int(i)).integers(self.max_step))
+        return self._raw_bases(indices, num_threads, pool=pool), ts
 
     def get_batch(self, indices: Sequence[int], num_threads: int = 8,
                   pool=None):
@@ -432,19 +465,7 @@ class ColdDownSampleDataset(_BaseCache):
         **uint8** (4× less host→device traffic than float32; the in-jit
         ``normalize_base`` conversion is bit-exact), else float32."""
         ts = np.asarray([self._draw_t(int(i)) for i in indices], np.int32)
-        if self.use_native:
-            entries = self._raw_entries(indices, num_threads, pool=pool)
-        else:  # per-item through the cache, fanned over the loader's pool
-            mapper = pool.map if pool is not None else map
-            entries = list(mapper(self._base, map(int, indices)))
-        # dtype is pinned per-DATASET (_uniform_u8), never per-batch: batches
-        # must agree across epochs and across SPMD hosts or the jitted step
-        # retraces / make_array_from_process_local_data gets mixed dtypes
-        if self._uniform_u8 and all(e.dtype == np.uint8 for e in entries):
-            base = np.stack(entries)
-        else:
-            base = np.stack([self._normalize(e) for e in entries])
-        return base, ts
+        return self._raw_bases(indices, num_threads, pool=pool), ts
 
     def _pil_item(self, index: int, t: int):
         img = _load_base(os.path.join(self.root, self.imgList[index]),

@@ -58,7 +58,7 @@ class ShardedLoader:
         if raw and not hasattr(dataset, "get_raw_batch"):
             raise ValueError(
                 f"raw=True needs dataset.get_raw_batch; {type(dataset).__name__} "
-                "has none (device-side corruption is a cold-dataset path)")
+                "does not implement the device-side corruption contract")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle

@@ -81,3 +81,26 @@ def make_cold_prepare(size: int, max_step: int, chain: bool):
         return noisy, target, t
 
     return prepare
+
+
+def make_gaussian_prepare(total_steps: int):
+    """In-jit Gaussian forward-noising for the device-side data path (C13).
+
+    The host ships ``(x₀, t)`` with t from the same Philox stream as the host
+    pipeline (identical noising *schedule*); ε is drawn ON DEVICE from the
+    step rng under ᾱ(t) = 1 − √((t+1)/T) (reference diffusion_loader.py:52-54,
+    the ViT.py:231 schedule). The noise bit-stream therefore differs from the
+    host path — statistically identical, not bit-identical, which is why the
+    trainer keeps the val loader on the host path (deterministic val loss).
+    """
+
+    def prepare(batch, rng):
+        base, t = batch
+        x = normalize_base(base)
+        alpha = 1.0 - jnp.sqrt((t.astype(jnp.float32) + 1.0) / total_steps)
+        alpha = alpha[:, None, None, None]
+        noise = jax.random.normal(rng, x.shape, jnp.float32)
+        noisy = jnp.sqrt(alpha) * x + jnp.sqrt(1.0 - alpha) * noise
+        return noisy, x, t
+
+    return prepare

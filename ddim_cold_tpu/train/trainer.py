@@ -190,25 +190,34 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     shard_index, shard_count = jax.process_index(), jax.process_count()
     train_set = _build_dataset(config, config.data_storage[0])
     test_set = _build_dataset(config, config.data_storage[1])
-    # device-side corruption: cold datasets ship (base, t) and the jitted step
-    # rebuilds (D(x,t), target, t) on device — bit-identical gathers, 2× less
-    # host→device traffic (the dominant per-step cost on tunneled TPU hosts)
-    raw_path = config.device_degrade and config.dataset in ("cold", "cold_direct")
-    prepare = None
-    if raw_path:
-        prepare = degrade.make_cold_prepare(
-            size=int(config.image_size[0]), max_step=train_set.max_step,
-            chain=(config.dataset == "cold"))
+    # device-side corruption: datasets ship clean bases and the jitted step
+    # rebuilds the corrupted batch on device — for cold, bit-identical gathers
+    # (both loaders); for gaussian, device-drawn ε (train loader only: the val
+    # loss stays on the deterministic host path). 2-8× less host→device
+    # traffic, the dominant per-step cost on tunneled TPU hosts.
+    is_cold = config.dataset in ("cold", "cold_direct")
+    raw_train = config.device_degrade and config.dataset in (
+        "cold", "cold_direct", "gaussian")
+    raw_eval = config.device_degrade and is_cold
+    prepare = eval_prepare = None
+    if raw_train:
+        if is_cold:
+            prepare = degrade.make_cold_prepare(
+                size=int(config.image_size[0]), max_step=train_set.max_step,
+                chain=(config.dataset == "cold"))
+            eval_prepare = prepare
+        else:
+            prepare = degrade.make_gaussian_prepare(config.total_steps)
     train_loader = ShardedLoader(
         train_set, global_batch // shard_count, shuffle=True, seed=config.seed,
         drop_last=True, shard_index=shard_index, shard_count=shard_count,
-        raw=raw_path,
+        raw=raw_train,
     )
     test_loader = ShardedLoader(
         test_set, global_batch // shard_count, shuffle=False, drop_last=False,
         shard_index=shard_index, shard_count=shard_count,
         pad_final_batch=True,  # sharded leading dim needs even divisibility
-        raw=raw_path,
+        raw=raw_eval,
     )
     train_batches, test_batches = len(train_loader), len(test_loader)
     if train_batches == 0:
@@ -272,7 +281,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
     train_step = make_train_step(model, apply_fn, prepare=prepare)
-    eval_step = make_eval_step(model, apply_fn, prepare=prepare)
+    eval_step = make_eval_step(model, apply_fn, prepare=eval_prepare)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
 

@@ -157,10 +157,56 @@ def test_loader_raw_mode_yields_pairs(cold_sets):
         assert base.shape == (4, 64, 64, 3) and ts.shape == (4,)
 
 
+def test_gaussian_raw_batch_and_prepare(synthetic_image_dir):
+    """Gaussian raw path: same t stream as the host pipeline, clean x₀ bases,
+    and the in-jit forward noising implements √ᾱ·x₀ + √(1−ᾱ)·ε with
+    device-drawn unit-normal ε (deterministic per rng)."""
+    ds = DiffusionDataset(synthetic_image_dir, imgSize=(32, 32), max_step=2000)
+    idxs = np.arange(10)
+    base, ts = ds.get_raw_batch(idxs, num_threads=2)
+    noisy_h, x0_h, ts_h = ds.get_batch(idxs, num_threads=2)
+    np.testing.assert_array_equal(ts, ts_h)
+    np.testing.assert_array_equal(base, x0_h)
+
+    prepare = degrade.make_gaussian_prepare(2000)
+    rng = jax.random.PRNGKey(5)
+    noisy, target, t_out = prepare((jnp.asarray(base), jnp.asarray(ts)), rng)
+    np.testing.assert_array_equal(np.asarray(target), base)
+    np.testing.assert_array_equal(np.asarray(t_out), ts)
+    # recover ε and check it is the exact device-normal draw
+    alpha = 1.0 - np.sqrt((ts.astype(np.float32) + 1.0) / 2000.0)
+    alpha = alpha[:, None, None, None]
+    eps = (np.asarray(noisy) - np.sqrt(alpha) * base) / np.sqrt(1.0 - alpha)
+    want_eps = np.asarray(jax.random.normal(rng, base.shape, jnp.float32))
+    np.testing.assert_allclose(eps, want_eps, atol=1e-4)
+    # deterministic: same rng → same batch
+    noisy2, _, _ = prepare((jnp.asarray(base), jnp.asarray(ts)), rng)
+    np.testing.assert_array_equal(np.asarray(noisy), np.asarray(noisy2))
+
+
+def test_trainer_gaussian_device_path_smoke(tmp_path, synthetic_image_dir):
+    """Gaussian + device_degrade trains (device-noised train loader) while
+    the val loader stays on the deterministic host path."""
+    from ddim_cold_tpu.config import ExperimentConfig
+    from ddim_cold_tpu.train.trainer import run
+
+    cfg = ExperimentConfig(
+        exp_name="g", framework="dd", batch_size=4, epoch=(0, 1),
+        base_lr=0.005, data_storage=(synthetic_image_dir, synthetic_image_dir),
+        image_size=(32, 32), patch_size=8, embed_dim=32, depth=2, head=2,
+        num_devices=1, dataset="gaussian", device_degrade=True,
+    )
+    result = run(cfg, str(tmp_path), max_steps=3)
+    assert np.isfinite(result.best_loss)
+
+
 def test_loader_raw_requires_capable_dataset(synthetic_image_dir):
-    gauss = DiffusionDataset(synthetic_image_dir, imgSize=(32, 32))
+    class NoRaw:
+        def __len__(self):
+            return 4
+
     with pytest.raises(ValueError, match="get_raw_batch"):
-        ShardedLoader(gauss, 4, shuffle=False, raw=True)
+        ShardedLoader(NoRaw(), 4, shuffle=False, raw=True)
 
 
 def test_train_step_equivalent_under_device_degrade(cold_sets):
