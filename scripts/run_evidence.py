@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""One-shot evidence pipeline: train → publish → FID → BASELINE update.
+
+Round-1 verdict items 1-3 in one command (designed to run unattended as soon
+as TPU access is available):
+
+1. generate the surrogate dataset if absent (scripts/make_dataset.py recipe);
+2. ``python multi_gpu_trainer.py 20220822`` — the reference's recorded
+   experiment (100 epochs, 512 train / 85 val batches @ effective batch 32);
+3. ``scripts/publish_run.py`` — committable results/: train.log,
+   metrics.jsonl, val-curve overlay vs the reference record, sample grids;
+4. ``scripts/compute_fid.py`` — FID between val images and cold samples from
+   bestloss.ckpt (seeded extractor; see that script for weight provenance);
+5. record the headline numbers into BASELINE.json's ``published`` map.
+
+Usage: python scripts/run_evidence.py [--skip-train] [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN = os.path.join(REPO, "Saved_Models", "20220822vit_tiny_diffusion")
+
+
+def sh(argv, **kw):
+    print(f"[evidence] $ {' '.join(argv)}", flush=True)
+    t0 = time.time()
+    subprocess.run(argv, check=True, cwd=REPO, **kw)
+    print(f"[evidence] done in {time.time() - t0:.0f}s", flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-train", action="store_true",
+                    help="run publish/FID against an existing Saved_Models run")
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="override epoch[1] (reduced-scale fallback runs)")
+    ap.add_argument("--fid-samples", type=int, default=1024)
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(os.path.join(REPO, "OxfordFlowers", "train")):
+        sh([sys.executable, "scripts/make_dataset.py", "--out", "OxfordFlowers"])
+
+    global RUN
+    if args.epochs is not None:
+        # reduced-scale runs live in their own exp dir; --skip-train reruns
+        # of the SAME flags must target it too, not the canonical run
+        name = f"20220822_e{args.epochs}"
+        RUN = os.path.join(REPO, "Saved_Models", name + "vit_tiny_diffusion")
+    else:
+        name = "20220822"
+
+    if not args.skip_train:
+        if args.epochs is not None:
+            import yaml
+
+            with open(os.path.join(REPO, "20220822.yaml")) as f:
+                cfg = yaml.safe_load(f)
+            cfg["epoch"] = [0, args.epochs]
+            with open(os.path.join(REPO, name + ".yaml"), "w") as f:
+                yaml.safe_dump(cfg, f)
+        sh([sys.executable, "multi_gpu_trainer.py", name])
+
+    sh([sys.executable, "scripts/publish_run.py", RUN])
+    sh([sys.executable, "scripts/compute_fid.py", RUN,
+        "--n-samples", str(args.fid_samples)])
+
+    run_name = os.path.basename(RUN)
+    out_dir = os.path.join(REPO, "results", run_name)
+    with open(os.path.join(out_dir, "summary.json")) as f:
+        summary = json.load(f)
+    with open(os.path.join(out_dir, "fid.json")) as f:
+        fid = json.load(f)
+
+    baseline_path = os.path.join(REPO, "BASELINE.json")
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    baseline.setdefault("published", {}).update({
+        "val_smooth_l1_best": summary["val_loss_best"],
+        "val_smooth_l1_epoch0": summary["val_loss_epoch0"],
+        "reference_val_smooth_l1_best": summary["reference_best"],
+        "epochs": summary["epochs"],
+        fid["metric"]: fid["value"],
+        "fid_extractor": fid["extractor"],
+        "dataset": summary["dataset"],
+    })
+    with open(baseline_path, "w") as f:
+        json.dump(baseline, f, indent=2)
+    print(json.dumps(baseline["published"], indent=2))
+    print(f"[evidence] BASELINE.json published map updated; artifacts in {out_dir}")
+
+
+if __name__ == "__main__":
+    main()
