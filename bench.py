@@ -43,8 +43,11 @@ def main(argv=None):
     ap.add_argument("--skip-northstar", action="store_true")
     ap.add_argument("--skip-e2e", action="store_true")
     ap.add_argument("--skip-scaling", action="store_true")
-    ap.add_argument("--ksweep", action="store_true",
-                    help="sweep sampler stride k over {1,5,20,50}")
+    ap.add_argument("--ksweep", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="sweep sampler stride k (BASELINE.json's k-sweep "
+                         "config). Default: on, except under --smoke; pass "
+                         "--ksweep/--no-ksweep to force either way")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (env JAX_PLATFORMS can be "
                          "overridden by site config; this flag always wins)")
@@ -70,6 +73,8 @@ def main(argv=None):
         # Pallas leg alone is minutes-to-hours under CPU interpret mode)
         args.steps = 10
         args.skip_northstar = args.skip_e2e = args.skip_scaling = True
+    if args.ksweep is None:  # default: full runs sweep, smoke doesn't —
+        args.ksweep = not args.smoke  # an explicit flag wins either way
 
     chip = jax.devices()[0].device_kind
     peak = flops_util.peak_tflops(chip)
