@@ -55,11 +55,18 @@ def main(argv=None):
 
     import jax
 
-    from ddim_cold_tpu.utils.platform import honor_env_platform
+    from ddim_cold_tpu.utils.platform import ensure_live_backend, honor_env_platform
 
     honor_env_platform()
+    platform_fallback = None
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        plat, reason = ensure_live_backend()
+        if plat == "cpu":
+            # wedged/unreachable TPU tunnel: a CPU-labelled record beats a
+            # bench that hangs forever and records nothing
+            platform_fallback = reason
     import jax.numpy as jnp
     import numpy as np
 
@@ -79,6 +86,8 @@ def main(argv=None):
     chip = jax.devices()[0].device_kind
     peak = flops_util.peak_tflops(chip)
     sub = {}
+    if platform_fallback:
+        sub["platform_fallback"] = f"ran on cpu — {platform_fallback}"
 
     def log(msg):
         print(f"[bench] {msg}", file=sys.stderr)

@@ -28,3 +28,86 @@ def honor_env_platform() -> None:
     if current.split(",")[0].strip() == want.split(",")[0].strip():
         return
     jax.config.update("jax_platforms", want)
+
+
+#: default probe body: apply the parent's effective platform choice (passed
+#: via env — the probe's own site hooks would otherwise re-pin it), then
+#: force real backend init.
+_PROBE_CODE = (
+    "import os, jax\n"
+    "p = os.environ.get('DDIM_COLD_PROBE_PLATFORMS')\n"
+    "if p: jax.config.update('jax_platforms', p)\n"
+    "jax.devices()\n"
+)
+#: a successful probe is valid this long (marker file mtime) — bursts of CLI
+#: invocations must not each pay a duplicate remote backend init + claim
+_PROBE_TTL_S = 600.0
+
+
+def ensure_live_backend(timeout_s: float = 120.0, *,
+                        _probe_code: str = _PROBE_CODE) -> tuple[str, str]:
+    """Bound backend initialization against a wedged remote-TPU tunnel.
+
+    A network-attached TPU whose session lock is stuck (e.g. a previous
+    client was hard-killed mid-claim) makes ``jax.devices()`` block FOREVER
+    in a claim-retry loop — and an in-process watchdog thread cannot rescue
+    it, because the hung init holds jax's backend-init lock so a later CPU
+    ``devices()`` deadlocks on the same lock (verified on the axon tunnel).
+    So the probe runs in a SUBPROCESS with the parent's effective platform
+    list: it either initializes that backend and exits cleanly (releasing
+    its claim), or we time it out / read its error and pin
+    ``jax_platforms=cpu`` in THIS process before any backend touch.
+
+    Returns ``(platform, reason)`` where platform is ``"default"`` (ambient
+    backend live, or probe skipped: already CPU-pinned / recent success
+    cached) or ``"cpu"`` (fallback applied; reason says whether the probe
+    hung or crashed, with a stderr tail). Call before the first device query.
+    """
+    import jax
+
+    # the parent's FIRST device query resolves from jax.config (site hooks
+    # and honor_env_platform write there); env is only the pre-import intent
+    effective = (jax.config.jax_platforms or "").strip() or os.environ.get(
+        "JAX_PLATFORMS", "").strip()
+    first = effective.split(",")[0].strip()
+    if first == "cpu":
+        return "default", "already cpu-pinned"
+
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    marker = os.path.join(tempfile.gettempdir(),
+                          f"ddim_cold_backend_ok_{first or 'site'}")
+    try:
+        if time.time() - os.path.getmtime(marker) < _PROBE_TTL_S:
+            return "default", "recent probe success cached"
+    except OSError:
+        pass
+
+    env = dict(os.environ)
+    if effective:
+        env["DDIM_COLD_PROBE_PLATFORMS"] = effective
+    # stderr to a FILE, stdout devnull: pipe capture can block past the
+    # timeout if the probe forked a helper that inherits the pipe ends
+    with tempfile.TemporaryFile() as errf:
+        try:
+            subprocess.run([sys.executable, "-c", _probe_code], check=True,
+                           stdout=subprocess.DEVNULL, stderr=errf,
+                           timeout=timeout_s, env=env)
+            try:
+                with open(marker, "w"):
+                    pass
+            except OSError:
+                pass
+            return "default", "probe ok"
+        except subprocess.TimeoutExpired:
+            reason = f"backend init probe hung >{timeout_s:.0f}s (wedged tunnel?)"
+        except subprocess.CalledProcessError as e:
+            errf.seek(0)
+            tail = errf.read()[-400:].decode("utf-8", "replace").strip()
+            reason = f"backend init probe failed (rc={e.returncode}): {tail}"
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu", reason

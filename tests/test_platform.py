@@ -1,0 +1,95 @@
+"""utils/platform: env-over-site-pin and the wedged-tunnel backend guard."""
+
+import os
+
+import pytest
+
+from ddim_cold_tpu.utils import platform as plat
+
+
+@pytest.fixture(autouse=True)
+def _no_probe_cache(tmp_path, monkeypatch):
+    """Point the probe's TTL marker at a fresh dir so tests never see (or
+    leave) a cached success."""
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    import tempfile
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+
+
+def _force_platform(monkeypatch, value):
+    """The guard resolves from jax.config first (conftest pins 'cpu' there);
+    route it through the env instead for these tests."""
+    import jax
+
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms",
+        property(lambda self: value), raising=False)
+
+
+def test_ensure_live_backend_skips_when_cpu_pinned():
+    # conftest pins jax.config.jax_platforms = "cpu" — the common CLI case
+    assert plat.ensure_live_backend()[0] == "default"
+
+
+def test_ensure_live_backend_probe_success(monkeypatch):
+    _force_platform(monkeypatch, "axon,cpu")
+    got, reason = plat.ensure_live_backend(timeout_s=30, _probe_code="pass")
+    assert got == "default" and reason == "probe ok"
+
+
+def test_ensure_live_backend_caches_success(monkeypatch):
+    _force_platform(monkeypatch, "axon,cpu")
+    assert plat.ensure_live_backend(timeout_s=30, _probe_code="pass")[1] == "probe ok"
+    got, reason = plat.ensure_live_backend(
+        timeout_s=30, _probe_code="raise SystemExit(9)")
+    assert got == "default" and "cached" in reason  # probe not re-run
+
+
+def test_ensure_live_backend_times_out_to_cpu(monkeypatch):
+    """A probe that never finishes (the wedged-tunnel claim loop) must pin
+    this process to CPU instead of letting the caller hang forever."""
+    import jax
+
+    _force_platform(monkeypatch, "axon,cpu")
+    update = jax.config.update
+    seen = {}
+    monkeypatch.setattr(
+        type(jax.config), "update",
+        lambda self, k, v: seen.update({k: v}) or update(k, v), raising=False)
+    got, reason = plat.ensure_live_backend(
+        timeout_s=1.0, _probe_code="import time; time.sleep(60)")
+    assert got == "cpu" and "hung" in reason
+    assert seen.get("jax_platforms") == "cpu"
+
+
+def test_ensure_live_backend_reports_crash_not_timeout(monkeypatch):
+    _force_platform(monkeypatch, "axon,cpu")
+    got, reason = plat.ensure_live_backend(
+        timeout_s=30,
+        _probe_code="import sys; print('boom-detail', file=sys.stderr); sys.exit(3)")
+    assert got == "cpu"
+    assert "rc=3" in reason and "boom-detail" in reason and "hung" not in reason
+
+
+def test_ensure_live_backend_passes_effective_platform_to_probe(monkeypatch):
+    """The probe must validate the PARENT's effective platform (jax.config —
+    site hooks write there), not whatever its own site hook would re-pin."""
+    _force_platform(monkeypatch, "fakeplat")
+    code = ("import os, sys\n"
+            "sys.exit(0 if os.environ.get('DDIM_COLD_PROBE_PLATFORMS') == "
+            "'fakeplat' else 7)")
+    got, reason = plat.ensure_live_backend(timeout_s=30, _probe_code=code)
+    assert got == "default", reason
+
+
+def test_honor_env_platform_reapplies_env(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")  # conftest state; idempotent
+    plat.honor_env_platform()
+    assert (jax.config.jax_platforms or "").split(",")[0] == "cpu"
+    monkeypatch.delenv("JAX_PLATFORMS")
+    plat.honor_env_platform()  # unset env → no-op
+    assert (jax.config.jax_platforms or "").split(",")[0] == "cpu"
