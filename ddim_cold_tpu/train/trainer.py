@@ -51,6 +51,65 @@ class TrainResult:
     run_dir: str
 
 
+class _GracefulStop:
+    """SIGTERM/SIGINT → set a flag; the epoch loop finishes the current step,
+    evaluates, checkpoints, and returns normally.
+
+    A hard-killed training process is not just lost work: on network-attached
+    TPU hosts the dead client's session claim can wedge the chip for every
+    later process (see utils/platform.ensure_live_backend). Exiting through
+    the normal path releases the claim and leaves a resumable lastepoch.ckpt.
+    A SECOND signal restores the previous dispositions and re-delivers
+    itself — truly urgent kill, not a second graceful pass. Handlers are only
+    installable from the main thread — elsewhere this is a no-op
+    (``requested`` stays False).
+
+    Multi-host: the local flag must NOT gate collective control flow directly
+    (only the signaled host would leave the loop — mismatched collectives
+    deadlock the slice); callers consult :meth:`agreed` at loop points every
+    host reaches at the same step.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self._prev: dict = {}
+
+    def agreed(self) -> bool:
+        """Cross-host consensus on the stop flag: True when ANY process was
+        signaled. Every process must call this at the same loop point."""
+        if jax.process_count() == 1:
+            return self.requested
+        from jax.experimental import multihost_utils
+
+        return bool(
+            multihost_utils.process_allgather(np.asarray([self.requested])).any())
+
+    def __enter__(self):
+        import signal
+
+        def handler(signum, frame):
+            if self.requested:  # second signal: restore + re-deliver → die now
+                for s, h in self._prev.items():
+                    signal.signal(s, h)
+                os.kill(os.getpid(), signum)
+                return
+            self.requested = True
+
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                self._prev[s] = signal.signal(s, handler)
+        except ValueError:  # not the main thread
+            self._prev = {}
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        return False
+
+
 class _AsyncSaver:
     """Runs each epoch's checkpoint writes in a background thread so the
     device→host pull + serialization overlap the next epoch's compute (the
@@ -303,6 +362,9 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     place = lambda b: shard_batch(b, mesh)  # noqa: E731
     saver = _AsyncSaver(
         sync=jax.process_count() > 1 or not config.async_checkpoint)
+    stopper = _GracefulStop()
+    stopper.__enter__()  # released AFTER the finally block below — a signal
+    # during the last in-flight checkpoint write must stay graceful too
     try:
         for epoch in range(epoch_start, config.epoch[1]):
             train_loader.set_epoch(epoch)
@@ -323,6 +385,15 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                         f"steps: {steps:8d} loss: {loss_rec:.4f} "
                         f"time_cost: {time_end - time_start:.2f}", log)
                     time_start = time.time()
+                # consensus check at an aligned loop point (every log window)
+                # — gating collectives on the host-local flag would leave
+                # only the signaled host's loop, deadlocking the slice
+                if steps % log_every == 0 and stopper.agreed():
+                    done = True
+                    if jax.process_index() == 0:
+                        print_log(f"stop signal at step {steps:8d} — "
+                                  "evaluating, checkpointing, exiting", log)
+                    break
                 if max_steps is not None and steps >= max_steps:
                     done = True
                     break
@@ -393,5 +464,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         # write (daemon thread killed at teardown mid-write would corrupt
         # the only resume point)
         saver.wait()
+        # only now hand signals back — a SIGTERM during the waits above
+        # stayed graceful (second signal escalates to an immediate kill)
+        stopper.__exit__()
     return TrainResult(best_loss=best_loss, last_val_loss=vloss, steps=steps,
                        run_dir=run_dir)

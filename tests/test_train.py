@@ -94,6 +94,44 @@ def test_resume_continues(trained_run, synthetic_image_dir):
     assert "epoch:    2" in log
 
 
+def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
+    """SIGTERM mid-training → the loop finishes the step, evaluates, saves
+    both checkpoints, and run() returns normally (a hard kill would lose the
+    epoch AND can wedge a remote TPU's session claim)."""
+    import os as _os
+    import signal
+    import threading
+    import time
+
+    from ddim_cold_tpu.train.trainer import run
+
+    base = str(tmp_path)
+    cfg = load_config(_write_config(base, synthetic_image_dir, epoch=[0, 200]),
+                      "exp")
+    log_path = os.path.join(base, "Saved_Models", cfg.run_name, "train.log")
+
+    def send_sigterm_once_training_started():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                if "steps:" in open(log_path).read():
+                    _os.kill(_os.getpid(), signal.SIGTERM)
+                    return
+            except OSError:
+                pass
+            time.sleep(0.25)
+
+    t = threading.Thread(target=send_sigterm_once_training_started, daemon=True)
+    t.start()
+    result = run(cfg, base, log_every=1)  # returns instead of dying
+    t.join()
+    assert result.steps < 200 * 5  # stopped early
+    assert np.isfinite(result.last_val_loss)
+    log = open(log_path).read()
+    assert "stop signal at step" in log
+    assert os.path.isdir(os.path.join(result.run_dir, "lastepoch.ckpt"))
+
+
 def test_loss_decreases_over_training(synthetic_image_dir):
     """Overfit one fixed batch through the real train_step: loss must drop."""
     import jax
