@@ -455,17 +455,20 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             if done:
                 break
     finally:
-        # cleanup first — a save error raised by wait() below must not strand
-        # a running profiler trace or drop buffered scalars
-        if profiling_until and jax.process_index() == 0:
-            profiling.stop_trace()  # run ended inside the trace window
-        writer.close()
-        # an epoch-loop exception must not strand an in-flight checkpoint
-        # write (daemon thread killed at teardown mid-write would corrupt
-        # the only resume point)
-        saver.wait()
-        # only now hand signals back — a SIGTERM during the waits above
-        # stayed graceful (second signal escalates to an immediate kill)
-        stopper.__exit__()
+        try:
+            # cleanup first — a save error raised by wait() below must not
+            # strand a running profiler trace or drop buffered scalars
+            if profiling_until and jax.process_index() == 0:
+                profiling.stop_trace()  # run ended inside the trace window
+            writer.close()
+            # an epoch-loop exception must not strand an in-flight checkpoint
+            # write (daemon thread killed at teardown mid-write would corrupt
+            # the only resume point)
+            saver.wait()
+        finally:
+            # hand signals back LAST and unconditionally — a SIGTERM during
+            # the waits above stayed graceful, and an error from them must
+            # not leak the flag-only handler past run()
+            stopper.__exit__()
     return TrainResult(best_loss=best_loss, last_val_loss=vloss, steps=steps,
                        run_dir=run_dir)

@@ -196,12 +196,44 @@ def _to_host(tree):
 
 
 def save_checkpoint(path: str, tree) -> None:
-    """Save a pytree checkpoint directory (orbax)."""
+    """Save a pytree checkpoint directory (orbax).
+
+    Single-host saves write beside the destination and swap in with two
+    rename metadata ops — ``force=True`` straight onto ``path`` would delete
+    the PREVIOUS checkpoint before the (multi-second, on tunneled hosts)
+    write, so a crash mid-write would lose the only resume point. Multi-host
+    saves go directly through orbax's own collective commit protocol (a
+    per-process directory swap on a shared fs would race).
+    """
+    import shutil
+
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, _to_host(tree), force=True)
+    if jax.process_count() > 1:
+        ckptr.save(path, _to_host(tree), force=True)
+        return
+    _recover_swap(path)
+    tmp, old = path + ".writing", path + ".old"
+    for d in (tmp, old):  # true leftovers (post-recovery) from a crashed save
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    ckptr.save(tmp, _to_host(tree), force=True)
+    if os.path.isdir(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.isdir(old):
+        shutil.rmtree(old)
+
+
+def _recover_swap(path: str) -> None:
+    """Heal a crash between the two swap renames in :func:`save_checkpoint`:
+    a lone ``<path>.old`` with no ``<path>`` IS the last good checkpoint —
+    move it back rather than ever treating it as deletable garbage."""
+    old = path + ".old"
+    if not os.path.isdir(path) and os.path.isdir(old):
+        os.rename(old, path)
 
 
 def restore_checkpoint(path: str, target=None):
@@ -214,6 +246,8 @@ def restore_checkpoint(path: str, target=None):
     """
     import orbax.checkpoint as ocp
 
+    if jax.process_count() == 1:
+        _recover_swap(os.path.abspath(path))  # heal a crashed save's swap
     ckptr = ocp.PyTreeCheckpointer()
     if target is None:
         return ckptr.restore(os.path.abspath(path))

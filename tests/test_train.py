@@ -94,6 +94,45 @@ def test_resume_continues(trained_run, synthetic_image_dir):
     assert "epoch:    2" in log
 
 
+def test_save_checkpoint_preserves_previous_on_failed_write(tmp_path, monkeypatch):
+    """A crashed/failed re-save must leave the previous checkpoint intact —
+    the old force=True-onto-destination path deleted it before writing."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    p = str(tmp_path / "last.ckpt")
+    ckpt.save_checkpoint(p, {"a": np.arange(3)})
+
+    import orbax.checkpoint as ocp
+
+    monkeypatch.setattr(
+        ocp.PyTreeCheckpointer, "save",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("disk full")))
+    with pytest.raises(RuntimeError, match="disk full"):
+        ckpt.save_checkpoint(p, {"a": np.arange(4)})
+    monkeypatch.undo()
+
+    got = ckpt.restore_checkpoint(p, {"a": np.zeros(3, np.int64)})
+    np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(3))
+
+
+def test_checkpoint_swap_crash_recovers_from_old(tmp_path):
+    """Crash between the two swap renames leaves only <path>.old — both save
+    and restore must move it back, never delete it as a leftover."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    p = str(tmp_path / "last.ckpt")
+    ckpt.save_checkpoint(p, {"a": np.arange(3)})
+    os.rename(p, p + ".old")  # simulate the crash window
+
+    got = ckpt.restore_checkpoint(p, {"a": np.zeros(3, np.int64)})
+    np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(3))
+
+    os.rename(p, p + ".old")
+    ckpt.save_checkpoint(p, {"a": np.arange(4)})  # recovery then overwrite
+    got = ckpt.restore_checkpoint(p, {"a": np.zeros(4, np.int64)})
+    np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(4))
+
+
 def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
     """SIGTERM mid-training → the loop finishes the step, evaluates, saves
     both checkpoints, and run() returns normally (a hard kill would lose the
