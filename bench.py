@@ -38,11 +38,15 @@ def main(argv=None):
     this harness as the single source of timing truth."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI/CPU)")
-    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed steps (default 100, or 10 under --smoke; an "
+                         "explicit value always wins)")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--skip-northstar", action="store_true")
     ap.add_argument("--skip-e2e", action="store_true")
     ap.add_argument("--skip-scaling", action="store_true")
+    ap.add_argument("--skip-sampler", action="store_true",
+                    help="skip the 64px sampler section (CI smoke)")
     ap.add_argument("--ksweep", action=argparse.BooleanOptionalAction,
                     default=None,
                     help="sweep sampler stride k (BASELINE.json's k-sweep "
@@ -78,8 +82,9 @@ def main(argv=None):
         # a smoke run is the train-step sanity check only — the north-star /
         # e2e / scaling sections are real-hardware measurements (the 200px
         # Pallas leg alone is minutes-to-hours under CPU interpret mode)
-        args.steps = 10
         args.skip_northstar = args.skip_e2e = args.skip_scaling = True
+    if args.steps is None:
+        args.steps = 10 if args.smoke else 100  # an explicit --steps wins
     if args.ksweep is None:  # default: full runs sweep, smoke doesn't —
         args.ksweep = not args.smoke  # an explicit flag wins either way
 
@@ -193,7 +198,8 @@ def main(argv=None):
         sub["sampler_throughput_64px_k20"] = {
             "value": round(n_sample / k20, 2), "unit": "img/s/chip"}
 
-    section("sampler_64px", run_sampler64)
+    if not args.skip_sampler:
+        section("sampler_64px", run_sampler64)
 
     def run_ksweep():
         sweep = {}

@@ -301,6 +301,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     steps, loss_rec, best_loss = 0, 5.0, 5.0
     if config.initializing not in ("", "none"):
         init_path = os.path.join(saved_dir, config.initializing)
+        ckpt.recover_swap(init_path)  # owner-side heal of a crashed save swap
         if os.path.isfile(init_path):
             state = state.replace(
                 params=ckpt.load_torch_pkl(init_path, config.patch_size))
@@ -314,6 +315,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                 ckpt.save_checkpoint(init_path, state.params)
 
     if config.resume != "none":
+        ckpt.recover_swap(config.resume)  # owner-side heal (crashed save swap)
         restored = ckpt.restore_checkpoint(
             config.resume,
             {"epoch": 0, "steps": 0, "loss_rec": 0.0, "metric": 0.0,
@@ -455,20 +457,21 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             if done:
                 break
     finally:
+        # every cleanup step must run even when an earlier one raises: an
+        # abandoned in-flight checkpoint write (saver.wait skipped) loses the
+        # final epoch, and a leaked signal handler outlives run()
         try:
-            # cleanup first — a save error raised by wait() below must not
-            # strand a running profiler trace or drop buffered scalars
-            if profiling_until and jax.process_index() == 0:
-                profiling.stop_trace()  # run ended inside the trace window
-            writer.close()
-            # an epoch-loop exception must not strand an in-flight checkpoint
-            # write (daemon thread killed at teardown mid-write would corrupt
-            # the only resume point)
-            saver.wait()
+            try:
+                if profiling_until and jax.process_index() == 0:
+                    profiling.stop_trace()  # run ended inside the trace window
+            finally:
+                writer.close()
         finally:
-            # hand signals back LAST and unconditionally — a SIGTERM during
-            # the waits above stayed graceful, and an error from them must
-            # not leak the flag-only handler past run()
-            stopper.__exit__()
+            try:
+                saver.wait()
+            finally:
+                # hand signals back LAST — a SIGTERM during the waits above
+                # stayed graceful (second signal escalates to immediate kill)
+                stopper.__exit__()
     return TrainResult(best_loss=best_loss, last_val_loss=vloss, steps=steps,
                        run_dir=run_dir)

@@ -214,7 +214,7 @@ def save_checkpoint(path: str, tree) -> None:
     if jax.process_count() > 1:
         ckptr.save(path, _to_host(tree), force=True)
         return
-    _recover_swap(path)
+    recover_swap(path)
     tmp, old = path + ".writing", path + ".old"
     for d in (tmp, old):  # true leftovers (post-recovery) from a crashed save
         if os.path.isdir(d):
@@ -227,11 +227,24 @@ def save_checkpoint(path: str, tree) -> None:
         shutil.rmtree(old)
 
 
-def _recover_swap(path: str) -> None:
+def recover_swap(path: str) -> None:
     """Heal a crash between the two swap renames in :func:`save_checkpoint`:
     a lone ``<path>.old`` with no ``<path>`` IS the last good checkpoint —
-    move it back rather than ever treating it as deletable garbage."""
+    move it back rather than ever treating it as deletable garbage.
+
+    Only the DIRECTORY OWNER (the trainer, on resume/warm-start and before
+    each save) may call this — a read-only consumer healing concurrently
+    with a writer's in-progress swap would race its second rename.
+    Multi-host: process 0 renames, everyone barriers."""
+    path = os.path.abspath(path)
     old = path + ".old"
+    if jax.process_count() > 1:
+        if jax.process_index() == 0 and not os.path.isdir(path) and os.path.isdir(old):
+            os.rename(old, path)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ddim_cold_ckpt_recover")
+        return
     if not os.path.isdir(path) and os.path.isdir(old):
         os.rename(old, path)
 
@@ -246,8 +259,6 @@ def restore_checkpoint(path: str, target=None):
     """
     import orbax.checkpoint as ocp
 
-    if jax.process_count() == 1:
-        _recover_swap(os.path.abspath(path))  # heal a crashed save's swap
     ckptr = ocp.PyTreeCheckpointer()
     if target is None:
         return ckptr.restore(os.path.abspath(path))

@@ -1,0 +1,22 @@
+"""bench.py smoke: the driver-facing record must always parse and carry the
+headline keys (a bench regression silently loses the round's BENCH record)."""
+
+import json
+
+import numpy as np
+
+
+def test_bench_smoke_record(capsys):
+    import bench
+
+    bench.main(["--smoke", "--cpu", "--steps", "3", "--batch", "4",
+                "--skip-sampler"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "train_throughput_vit_tiny64_b32"
+    assert np.isfinite(rec["value"]) and rec["value"] > 0
+    assert rec["unit"] == "img/s"
+    assert np.isfinite(rec["vs_baseline"])
+    assert rec["chip"] == "cpu"
+    assert "submetrics" in rec and isinstance(rec["submetrics"], dict)
+    assert np.isfinite(rec["ms_per_step"]) and rec["ms_per_step"] > 0

@@ -116,19 +116,22 @@ def test_save_checkpoint_preserves_previous_on_failed_write(tmp_path, monkeypatc
 
 
 def test_checkpoint_swap_crash_recovers_from_old(tmp_path):
-    """Crash between the two swap renames leaves only <path>.old — both save
-    and restore must move it back, never delete it as a leftover."""
+    """Crash between the two swap renames leaves only <path>.old — the owner
+    (recover_swap, called by the trainer's resume path and by save itself)
+    must move it back, never delete it as a leftover. restore stays
+    read-only (a concurrent reader must not race a writer's swap)."""
     from ddim_cold_tpu.utils import checkpoint as ckpt
 
     p = str(tmp_path / "last.ckpt")
     ckpt.save_checkpoint(p, {"a": np.arange(3)})
     os.rename(p, p + ".old")  # simulate the crash window
 
+    ckpt.recover_swap(p)
     got = ckpt.restore_checkpoint(p, {"a": np.zeros(3, np.int64)})
     np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(3))
 
     os.rename(p, p + ".old")
-    ckpt.save_checkpoint(p, {"a": np.arange(4)})  # recovery then overwrite
+    ckpt.save_checkpoint(p, {"a": np.arange(4)})  # save-side heal + overwrite
     got = ckpt.restore_checkpoint(p, {"a": np.zeros(4, np.int64)})
     np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(4))
 
