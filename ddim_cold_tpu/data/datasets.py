@@ -224,7 +224,19 @@ class _BaseCache:
         else:  # per-item through the cache, fanned over the loader's pool
             mapper = pool.map if pool is not None else map
             entries = list(mapper(self._base, map(int, indices)))
-        if self._uniform_u8 and all(e.dtype == np.uint8 for e in entries):
+        if self._uniform_u8:
+            bad = [int(i) for i, e in zip(indices, entries)
+                   if e.dtype != np.uint8]
+            if bad:
+                # never silently flip the batch dtype mid-run: it forces a jit
+                # retrace, and under multi-host SPMD a single host shipping
+                # float32 while the rest ship uint8 diverges the global array
+                # dtype (hang/crash). Only cause: a file changed on disk after
+                # the header probe pinned this dataset uint8.
+                raise RuntimeError(
+                    f"dataset pinned uint8 but indices {bad[:8]} decoded to a "
+                    "different dtype — files mutated after the header probe; "
+                    "rebuild the dataset or reopen it to re-probe")
             return np.stack(entries)
         return np.stack([self._normalize(e) for e in entries])
 

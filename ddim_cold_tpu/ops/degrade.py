@@ -59,7 +59,28 @@ def normalize_base(base: jax.Array) -> jax.Array:
     return base
 
 
-def make_cold_prepare(size: int, max_step: int, chain: bool):
+def _batch_constrain(mesh, batch_axis):
+    """Sharding hint pinning arrays batch-sharded, all other dims replicated.
+
+    The degrade gathers are per-sample ops: partitioned over batch they need
+    zero communication, but left to the partitioner's cost model under a
+    dp×tp×sp mesh it can pick a W-sharded layout for the gather and then hit
+    "Involuntary full rematerialization" resharding into the attention layout
+    (replicate-the-tensor fallback — MULTICHIP_r02 tail). Identity when no
+    mesh is given or the axis isn't in it (single-chip callers)."""
+    if mesh is None or batch_axis not in getattr(mesh, "axis_names", ()):
+        return lambda a: a
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def con(a):
+        spec = PartitionSpec(batch_axis, *([None] * (a.ndim - 1)))
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+    return con
+
+
+def make_cold_prepare(size: int, max_step: int, chain: bool, *,
+                      mesh=None, batch_axis: str = "data"):
     """In-jit batch corruption for the device-side cold data path.
 
     The host ships only ``(base, t)`` — one clean image per sample instead of
@@ -70,20 +91,28 @@ def make_cold_prepare(size: int, max_step: int, chain: bool):
     is bit-identical to the host/C++ pipeline. ``normalize_base`` additionally
     accepts uint8 bases (a further 4× for identity-resize datasets) for
     callers that ship raw bytes.
+
+    ``mesh``/``batch_axis`` keep the gathers batch-sharded under SPMD (see
+    ``_batch_constrain``); pass the training mesh whenever the step is jitted
+    over one.
     """
+    con = _batch_constrain(mesh, batch_axis)
 
     def prepare(batch, rng):
         del rng  # cold corruption is deterministic given (base, t)
         base, t = batch
-        x = normalize_base(base)
-        noisy = cold_degrade(x, t, size=size, max_step=max_step)
-        target = cold_degrade(x, t - 1, size=size, max_step=max_step) if chain else x
+        x = con(normalize_base(base))
+        t = con(t)
+        noisy = con(cold_degrade(x, t, size=size, max_step=max_step))
+        target = (con(cold_degrade(x, t - 1, size=size, max_step=max_step))
+                  if chain else x)
         return noisy, target, t
 
     return prepare
 
 
-def make_gaussian_prepare(total_steps: int):
+def make_gaussian_prepare(total_steps: int, *, mesh=None,
+                          batch_axis: str = "data"):
     """In-jit Gaussian forward-noising for the device-side data path (C13).
 
     The host ships ``(x₀, t)`` with t from the same Philox stream as the host
@@ -94,9 +123,12 @@ def make_gaussian_prepare(total_steps: int):
     trainer keeps the val loader on the host path (deterministic val loss).
     """
 
+    con = _batch_constrain(mesh, batch_axis)
+
     def prepare(batch, rng):
         base, t = batch
-        x = normalize_base(base)
+        x = con(normalize_base(base))
+        t = con(t)
         alpha = 1.0 - jnp.sqrt((t.astype(jnp.float32) + 1.0) / total_steps)
         alpha = alpha[:, None, None, None]
         noise = jax.random.normal(rng, x.shape, jnp.float32)

@@ -263,10 +263,11 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         if is_cold:
             prepare = degrade.make_cold_prepare(
                 size=int(config.image_size[0]), max_step=train_set.max_step,
-                chain=(config.dataset == "cold"))
+                chain=(config.dataset == "cold"), mesh=mesh)
             eval_prepare = prepare
         else:
-            prepare = degrade.make_gaussian_prepare(config.total_steps)
+            prepare = degrade.make_gaussian_prepare(config.total_steps,
+                                                    mesh=mesh)
     train_loader = ShardedLoader(
         train_set, global_batch // shard_count, shuffle=True, seed=config.seed,
         drop_last=True, shard_index=shard_index, shard_count=shard_count,

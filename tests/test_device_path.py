@@ -54,6 +54,28 @@ def test_prepare_rebuilds_host_batch_bitexact(cold_sets):
     np.testing.assert_array_equal(np.asarray(d_ts), ts)
 
 
+def test_cold_prepare_pins_batch_sharding_under_mesh():
+    """Under a dp×tp×sp mesh the degrade gathers must stay batch-sharded —
+    left to the partitioner they can land W-sharded and trigger XLA's
+    "Involuntary full rematerialization" replicate-all fallback on the
+    reshard into the attention layout (MULTICHIP_r02 tail)."""
+    from ddim_cold_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+    prepare = degrade.make_cold_prepare(size=16, max_step=4, chain=True,
+                                        mesh=mesh)
+    base = jnp.zeros((8, 16, 16, 3), jnp.uint8)
+    t = jnp.ones((8,), jnp.int32)
+    noisy, target, _ = jax.jit(
+        lambda b: prepare(b, jax.random.PRNGKey(0)))((base, t))
+    for arr in (noisy, target):
+        spec = arr.sharding.spec
+        assert spec and spec[0] == "data", spec
+        assert all(s is None for s in spec[1:]), spec
+
+
 def test_uint8_base_normalizes_bitexact(rng):
     """uint8-shipped bases must normalize to the exact host float pipeline
     (÷255 then ·2−1, datasets._load_base order)."""
@@ -123,6 +145,29 @@ def test_raw_dtype_stable_for_mixed_size_dataset(tmp_path):
     # a batch containing ONLY exact-size files still ships float32
     base, _ = ds.get_raw_batch([0, 1, 2], num_threads=1)
     assert base.dtype == np.float32
+
+
+def test_raw_dtype_drift_raises_not_silent_flip(tmp_path):
+    """A file mutated on disk AFTER the header probe pinned the dataset uint8
+    must raise, not silently ship a float32 batch (jit retrace; multi-host
+    global-dtype divergence)."""
+    from PIL import Image
+
+    from ddim_cold_tpu.data import native
+
+    if not native.available():
+        pytest.skip("uint8 pinning requires the native decoder")
+    rs = np.random.RandomState(5)
+    for i in range(4):
+        Image.fromarray(rs.randint(0, 255, (64, 64, 3), np.uint8)).save(
+            tmp_path / f"img_{i}.jpg")
+    ds = ColdDownSampleDataset(str(tmp_path), imgSize=(64, 64),
+                               target_mode="chain")
+    assert ds._uniform_u8
+    Image.fromarray(rs.randint(0, 255, (80, 80, 3), np.uint8)).save(
+        tmp_path / "img_1.jpg")  # now needs a resize → float32 decode path
+    with pytest.raises(RuntimeError, match="pinned uint8"):
+        ds.get_raw_batch([0, 1, 2], num_threads=1)
 
 
 def test_native_decode_batch_parity(exact_size_image_dir):

@@ -83,6 +83,38 @@ def test_ensure_live_backend_passes_effective_platform_to_probe(monkeypatch):
     assert got == "default", reason
 
 
+def test_ensure_live_backend_retries_then_succeeds(tmp_path, monkeypatch):
+    """A transiently-failing probe must be retried (with backoff) before the
+    guard gives up the round's hardware record to a CPU fallback."""
+    _force_platform(monkeypatch, "axon,cpu")
+    flag = tmp_path / "second_attempt_flag"
+    code = ("import os, sys\n"
+            f"p = {str(flag)!r}\n"
+            "if os.path.exists(p): sys.exit(0)\n"
+            "open(p, 'w').close(); sys.exit(5)\n")
+    got, reason = plat.ensure_live_backend(
+        timeout_s=30, attempts=2, backoff_s=0.01, _probe_code=code)
+    assert got == "default" and "attempt 2" in reason
+
+
+def test_ensure_live_backend_exhausts_attempts(monkeypatch):
+    _force_platform(monkeypatch, "axon,cpu")
+    got, reason = plat.ensure_live_backend(
+        timeout_s=30, attempts=3, backoff_s=0.01,
+        _probe_code="import sys; sys.exit(2)")
+    assert got == "cpu" and "3 attempts" in reason
+
+
+def test_marker_path_is_per_user(monkeypatch, tmp_path):
+    """The probe-success cache must not be shareable across users — a foreign
+    stale marker would skip the probe against a wedged tunnel."""
+    _force_platform(monkeypatch, "axon,cpu")
+    plat.ensure_live_backend(timeout_s=30, _probe_code="pass")
+    markers = [p for p in os.listdir(tmp_path)
+               if p.startswith("ddim_cold_backend_ok_")]
+    assert markers == [f"ddim_cold_backend_ok_{os.getuid()}_axon"]
+
+
 def test_honor_env_platform_reapplies_env(monkeypatch):
     import jax
 
