@@ -85,6 +85,9 @@ class ExperimentConfig:
     async_checkpoint: bool = True
     scan_blocks: bool = False  # nn.scan over depth (stacked params)
     microbatches: Optional[int] = None  # pipeline microbatches (default 2·pipe)
+    # every N epochs, additionally save params to <run>/snapshots/epoch_<E>/ —
+    # feeds the per-checkpoint FID trend (scripts/fid_trend.py); 0 = off
+    snapshot_epochs: int = 0
 
     @property
     def effective_batch(self) -> int:
@@ -177,4 +180,5 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         async_checkpoint=bool(raw.get("async_checkpoint", True)),
         scan_blocks=bool(raw.get("scan_blocks", False)),
         microbatches=(int(raw["microbatches"]) if "microbatches" in raw else None),
+        snapshot_epochs=int(raw.get("snapshot_epochs", 0)),
     )

@@ -447,6 +447,13 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                                 config.patch_size)
                         except ImportError:
                             pass
+                if config.snapshot_epochs and epoch % config.snapshot_epochs == 0:
+                    # bare-params snapshot for the FID trend
+                    # (scripts/fid_trend.py); keyed by epoch, never rewritten
+                    snap_dir = os.path.join(run_dir, "snapshots")
+                    os.makedirs(snap_dir, exist_ok=True)
+                    ckpt.save_checkpoint(
+                        os.path.join(snap_dir, f"epoch_{epoch}"), params)
                 ckpt.save_checkpoint(
                     os.path.join(run_dir, "lastepoch.ckpt"),
                     {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,

@@ -44,6 +44,18 @@ _PROBE_CODE = (
 _PROBE_TTL_S = 600.0
 
 
+def probe_marker_path(first: str) -> str:
+    """Per-user probe-success marker for platform ``first`` — shared by
+    :func:`ensure_live_backend` and the recovery watcher
+    (scripts/watch_tpu.py) so a watcher-observed recovery immediately
+    unblocks CLI probes."""
+    import tempfile
+
+    uid = os.getuid() if hasattr(os, "getuid") else "nt"
+    return os.path.join(tempfile.gettempdir(),
+                        f"ddim_cold_backend_ok_{uid}_{first or 'site'}")
+
+
 def ensure_live_backend(timeout_s: float = 120.0, *, attempts: int = 1,
                         backoff_s: float = 45.0,
                         _probe_code: str = _PROBE_CODE) -> tuple[str, str]:
@@ -87,9 +99,7 @@ def ensure_live_backend(timeout_s: float = 120.0, *, attempts: int = 1,
     # per-user marker: on a shared host a world-shared path could be owned or
     # pre-created by another user — at best the cache never writes, at worst a
     # stale foreign marker skips the probe against a wedged tunnel
-    uid = os.getuid() if hasattr(os, "getuid") else "nt"
-    marker = os.path.join(tempfile.gettempdir(),
-                          f"ddim_cold_backend_ok_{uid}_{first or 'site'}")
+    marker = probe_marker_path(first)
     try:
         if time.time() - os.path.getmtime(marker) < _PROBE_TTL_S:
             return "default", "recent probe success cached"

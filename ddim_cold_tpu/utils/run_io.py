@@ -14,14 +14,17 @@ from __future__ import annotations
 import os
 
 
-def load_run(run_dir: str):
-    """→ (config, model, params) for the run's best checkpoint."""
+def load_run_template(run_dir: str):
+    """→ (config, model, template_params) — the run's model rebuilt from its
+    own yaml plus a freshly-initialized param tree to restore checkpoints
+    against. The single source of the template recipe (dtype, init rng, yaml
+    selection); every checkpoint-loading script goes through here so the
+    recipe can never drift between them."""
     import jax
     import jax.numpy as jnp
 
     from ddim_cold_tpu.config import load_config
     from ddim_cold_tpu.models import DiffusionViT
-    from ddim_cold_tpu.utils import checkpoint as ckpt
 
     yamls = [f for f in os.listdir(run_dir) if f.endswith(".yaml")]
     if not yamls:
@@ -33,6 +36,14 @@ def load_run(run_dir: str):
         jax.random.PRNGKey(0),
         jnp.zeros((1, *config.image_size, 3)), jnp.zeros((1,), jnp.int32),
     )["params"]
+    return config, model, template
+
+
+def load_run(run_dir: str):
+    """→ (config, model, params) for the run's best checkpoint."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    config, model, template = load_run_template(run_dir)
     params = ckpt.restore_checkpoint(
         os.path.join(run_dir, "bestloss.ckpt"), template)
     return config, model, params

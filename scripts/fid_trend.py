@@ -33,6 +33,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def collect_points(run_dir: str, max_points: int):
+    """→ ordered [(label, epoch|None, ckpt_path|None)] trend points: the
+    random-init anchor, evenly-thinned snapshot epochs (first and last always
+    kept), then the run's best checkpoint."""
+    import numpy as np
+
+    points = [("random", -1, None)]  # anchor: params as-initialized
+    snap_dir = os.path.join(run_dir, "snapshots")
+    if os.path.isdir(snap_dir):
+        snaps = []
+        for name in os.listdir(snap_dir):
+            m = re.fullmatch(r"epoch_(\d+)", name)
+            if m:
+                snaps.append((int(m.group(1)), os.path.join(snap_dir, name)))
+        snaps.sort()
+        if len(snaps) > max_points:  # thin evenly, keep first + last
+            idx = np.linspace(0, len(snaps) - 1, max_points).round()
+            snaps = [snaps[int(i)] for i in sorted(set(idx.astype(int)))]
+        points += [(f"epoch_{ep}", ep, path) for ep, path in snaps]
+    best = os.path.join(run_dir, "bestloss.ckpt")
+    if os.path.isdir(best):
+        points.append(("best", None, best))
+    return points
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("run_dir", nargs="?", default=os.path.join(
@@ -50,52 +75,27 @@ def main(argv=None):
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
 
-    from ddim_cold_tpu.utils.platform import honor_env_platform
+    from ddim_cold_tpu.utils.platform import ensure_live_backend, honor_env_platform
 
     honor_env_platform()
     import jax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
+    else:
+        ensure_live_backend()  # wedged tunnel → CPU instead of hanging
     import numpy as np
 
-    from ddim_cold_tpu.config import load_config
     from ddim_cold_tpu.data import ColdDownSampleDataset, ShardedLoader
     from ddim_cold_tpu.eval import fid, inception
-    from ddim_cold_tpu.models import DiffusionViT
     from ddim_cold_tpu.ops import sampling
     from ddim_cold_tpu.utils import checkpoint as ckpt
+    from ddim_cold_tpu.utils.run_io import load_run_template
 
     run_dir = args.run_dir
-    yamls = [f for f in os.listdir(run_dir) if f.endswith(".yaml")]
-    if not yamls:
-        raise FileNotFoundError(f"no experiment yaml in {run_dir}")
-    config = load_config(os.path.join(run_dir, yamls[0]),
-                         os.path.splitext(yamls[0])[0])
-    model = DiffusionViT(dtype=jnp.bfloat16, **config.model_kwargs())
-    template = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((1, *config.image_size, 3)), jnp.zeros((1,), jnp.int32),
-    )["params"]
+    config, model, template = load_run_template(run_dir)
 
-    # -- checkpoint points --------------------------------------------------
-    points = [("random", -1, None)]  # anchor: template params as-initialized
-    snap_dir = os.path.join(run_dir, "snapshots")
-    if os.path.isdir(snap_dir):
-        snaps = []
-        for name in os.listdir(snap_dir):
-            m = re.fullmatch(r"epoch_(\d+)", name)
-            if m:
-                snaps.append((int(m.group(1)), os.path.join(snap_dir, name)))
-        snaps.sort()
-        if len(snaps) > args.max_points:  # thin evenly, keep first + last
-            idx = np.linspace(0, len(snaps) - 1, args.max_points).round()
-            snaps = [snaps[int(i)] for i in sorted(set(idx.astype(int)))]
-        points += [(f"epoch_{ep}", ep, path) for ep, path in snaps]
-    best = os.path.join(run_dir, "bestloss.ckpt")
-    if os.path.isdir(best):
-        points.append(("best", None, best))
+    points = collect_points(run_dir, args.max_points)
 
     # -- fixed extractor + shared real statistics ---------------------------
     inc_model, inc_vars = inception.init_variables(
@@ -123,10 +123,13 @@ def main(argv=None):
         if path is None:
             return template
         if os.path.basename(path).startswith("epoch_"):
-            # snapshots copy lastepoch.ckpt, which holds the full resume state
-            # {epoch, steps, loss_rec, metric, params, opt_state}; raw-restore
-            # and take params, cast onto the template's dtypes
-            raw = ckpt.restore_checkpoint(path)["params"]
+            # two snapshot layouts exist: the trainer's snapshot_epochs option
+            # writes bare params; out-of-band collectors copy lastepoch.ckpt,
+            # which holds the full resume state with a "params" entry. Raw-
+            # restore, unwrap if needed, cast onto the template's dtypes.
+            raw = ckpt.restore_checkpoint(path)
+            if isinstance(raw, dict) and "params" in raw and "opt_state" in raw:
+                raw = raw["params"]
             return jax.tree.map(
                 lambda t, v: np.asarray(v, np.asarray(t).dtype), template, raw)
         return ckpt.restore_checkpoint(path, template)  # bestloss: bare params

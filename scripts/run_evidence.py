@@ -43,6 +43,10 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=None,
                     help="override epoch[1] (reduced-scale fallback runs)")
     ap.add_argument("--fid-samples", type=int, default=1024)
+    ap.add_argument("--fid-real", type=int, default=2048,
+                    help="real images for FID statistics (both scripts)")
+    ap.add_argument("--trend-samples", type=int, default=256,
+                    help="samples per fid_trend point")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(os.path.join(REPO, "OxfordFlowers", "train")):
@@ -70,7 +74,15 @@ def main(argv=None):
 
     sh([sys.executable, "scripts/publish_run.py", RUN])
     sh([sys.executable, "scripts/compute_fid.py", RUN,
-        "--n-samples", str(args.fid_samples)])
+        "--n-samples", str(args.fid_samples), "--n-real", str(args.fid_real)])
+    try:
+        # per-checkpoint trend under the same seeded extractor (works even
+        # without snapshots/: random-init anchor + best still give 2 points)
+        sh([sys.executable, "scripts/fid_trend.py", RUN,
+            "--n-samples", str(args.trend_samples),
+            "--n-real", str(args.fid_real)])
+    except subprocess.CalledProcessError as e:
+        print(f"[evidence] fid_trend failed (non-fatal): {e}", flush=True)
 
     run_name = os.path.basename(RUN)
     out_dir = os.path.join(REPO, "results", run_name)

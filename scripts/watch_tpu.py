@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Wedged-tunnel recovery watcher — the standing half of the failure-
+detection story (SURVEY.md §5: failure detect/recovery).
+
+``ensure_live_backend`` bounds a single CLI start against a wedged remote-TPU
+tunnel; this watcher covers the other direction — a host whose tunnel is
+*currently* wedged and which should resume hardware work the moment the
+remote session lock clears. It probes backend liveness in bounded
+SUBPROCESSES (never initializing a backend in-process, so the watcher itself
+can never hang), refreshes the probe-success marker shared with
+``ensure_live_backend`` (so every CLI starts instantly once the tunnel is
+back), and optionally runs a one-shot recovery hook — e.g. a script that
+gracefully stops a CPU-fallback trainer and relaunches the evidence chain on
+the chip.
+
+    python scripts/watch_tpu.py --interval 480 \
+        --once-exec 'bash /tmp/recover_chain.sh'
+
+Exits after the hook fires (or never, with no hook). A probe that times out
+is killed safely: it was blocked *waiting* for the claim and never held the
+grant (the wedge this guards against comes from killing a client that HELD
+it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddim_cold_tpu.utils.platform import _PROBE_CODE, probe_marker_path  # noqa: E402
+
+
+def probe_once(platforms: str | None, timeout_s: float) -> tuple[bool, str]:
+    """One bounded liveness probe in a subprocess. → (alive, detail)."""
+    env = dict(os.environ)
+    if platforms:
+        env["DDIM_COLD_PROBE_PLATFORMS"] = platforms
+    try:
+        subprocess.run([sys.executable, "-c", _PROBE_CODE], check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                       timeout=timeout_s, env=env)
+        return True, "probe ok"
+    except subprocess.TimeoutExpired:
+        return False, f"hung >{timeout_s:.0f}s"
+    except subprocess.CalledProcessError as e:
+        return False, f"rc={e.returncode}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=480.0,
+                    help="seconds between probes")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-probe bound")
+    ap.add_argument("--platforms", default=None,
+                    help="platform list for the probe (default: the site's "
+                         "own pin, i.e. probe whatever a plain CLI would "
+                         "use). Also keys the success marker — it must name "
+                         "the CLIs' effective FIRST platform for them to "
+                         "skip their own probes on recovery")
+    ap.add_argument("--once-exec", default=None,
+                    help="shell command run ONCE on the first success; the "
+                         "watcher exits after it returns")
+    ap.add_argument("--log", default=None, help="append probe results here")
+    args = ap.parse_args(argv)
+
+    def note(msg):
+        line = f"{time.strftime('%F %T')} [watch-tpu] {msg}"
+        print(line, flush=True)
+        if args.log:
+            with open(args.log, "a") as f:
+                f.write(line + "\n")
+
+    note(f"watching (interval={args.interval:.0f}s, timeout={args.timeout:.0f}s)")
+    while True:
+        alive, detail = probe_once(args.platforms, args.timeout)
+        note(f"{'ALIVE' if alive else 'down'} ({detail})")
+        if alive:
+            # marker key must match what ensure_live_backend computes in the
+            # CLIs: their effective first platform. Without --platforms the
+            # best jax-free approximation is the env pin (the same value site
+            # hooks apply); ensure_live_backend's own probe stays the
+            # fallback when the two disagree.
+            first = (args.platforms or os.environ.get("JAX_PLATFORMS", "")
+                     or "axon").split(",")[0].strip()
+            marker = probe_marker_path(first)
+            try:
+                with open(marker, "w"):
+                    pass
+            except OSError:
+                pass
+            if args.once_exec:
+                note(f"recovery hook: {args.once_exec}")
+                rc = subprocess.call(args.once_exec, shell=True)
+                note(f"recovery hook exited rc={rc}")
+                return rc
+            # no hook: keep refreshing the marker so CLIs skip their probes
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,15 @@
 """FID subsystem: Fréchet math vs closed forms/scipy, streaming stats vs
 numpy, InceptionV3 forward + torch-layout weight conversion."""
 
+import os
+
 import numpy as np
 import pytest
 
 from ddim_cold_tpu.eval import fid
 from ddim_cold_tpu.eval import inception
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_frechet_identical_is_zero(rng):
@@ -143,6 +147,38 @@ def test_fid_between_images(rng):
     c = fid.stats_for_batches([other], feature_fn, dim)
     assert abs(fid.fid_from_stats(a, b)) < 1e-6
     assert fid.fid_from_stats(a, c) > fid.fid_from_stats(a, b)
+
+
+def test_fid_trend_collect_points(tmp_path):
+    """scripts/fid_trend.py point assembly: random anchor first, snapshot
+    epochs sorted + evenly thinned with first/last kept, best last."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fid_trend", os.path.join(REPO, "scripts", "fid_trend.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    run = tmp_path
+    snap = run / "snapshots"
+    snap.mkdir()
+    for ep in (3, 1, 21, 7, 11, 15, 9):
+        (snap / f"epoch_{ep}").mkdir()
+    (snap / "epoch_5.tmp").mkdir()  # in-flight copy: must be ignored
+    (run / "bestloss.ckpt").mkdir()
+
+    pts = mod.collect_points(str(run), max_points=4)
+    labels = [p[0] for p in pts]
+    assert labels[0] == "random" and labels[-1] == "best"
+    epochs = [p[1] for p in pts[1:-1]]
+    assert epochs == sorted(epochs) and len(epochs) <= 4
+    assert epochs[0] == 1 and epochs[-1] == 21  # first/last survive thinning
+    assert pts[0][2] is None and pts[-1][2].endswith("bestloss.ckpt")
+
+    # no snapshots, no best → still a valid 1-point (random) trend
+    empty = tmp_path / "empty_run"
+    empty.mkdir()
+    assert [p[0] for p in mod.collect_points(str(empty), 4)] == ["random"]
 
 
 def test_random_extractor_features_do_not_collapse(rng):

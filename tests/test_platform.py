@@ -115,6 +115,22 @@ def test_marker_path_is_per_user(monkeypatch, tmp_path):
     assert markers == [f"ddim_cold_backend_ok_{os.getuid()}_axon"]
 
 
+def test_watch_tpu_probe_once():
+    """scripts/watch_tpu.py probe primitive: live backend → ALIVE; a
+    nonexistent platform → down with the subprocess rc, not a hang."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "watch_tpu", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "watch_tpu.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    alive, detail = mod.probe_once("cpu", timeout_s=60)
+    assert alive, detail
+    alive, detail = mod.probe_once("no_such_platform", timeout_s=60)
+    assert not alive and detail.startswith("rc=")
+
+
 def test_honor_env_platform_reapplies_env(monkeypatch):
     import jax
 

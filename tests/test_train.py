@@ -56,7 +56,8 @@ def trained_run(tmp_path_factory, synthetic_image_dir):
     from ddim_cold_tpu.train.trainer import run
 
     base = str(tmp_path_factory.mktemp("run"))
-    cfg = load_config(_write_config(base, synthetic_image_dir), "exp")
+    cfg = load_config(_write_config(base, synthetic_image_dir,
+                                    snapshot_epochs=1), "exp")
     result = run(cfg, base, log_every=2)
     return base, cfg, result
 
@@ -75,6 +76,21 @@ def test_train_end_to_end(trained_run):
     assert "steps:" in log and "time_cost:" in log  # reference line format
     assert "epoch:    0" in log and "epoch:    1" in log
     assert os.path.isfile(os.path.join(run_dir, "metrics.jsonl"))
+
+
+def test_snapshot_epochs_writes_trend_checkpoints(trained_run):
+    """snapshot_epochs=N saves bare params to snapshots/epoch_<E> — the
+    per-checkpoint FID-trend source (scripts/fid_trend.py collect_points)."""
+    import jax
+
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    _, cfg, result = trained_run
+    snap = os.path.join(result.run_dir, "snapshots")
+    assert sorted(os.listdir(snap)) == ["epoch_0", "epoch_1"]
+    raw = ckpt.restore_checkpoint(os.path.join(snap, "epoch_0"))
+    best = ckpt.restore_checkpoint(os.path.join(result.run_dir, "bestloss.ckpt"))
+    assert jax.tree.structure(raw) == jax.tree.structure(best)  # bare params
 
 
 def test_resume_continues(trained_run, synthetic_image_dir):
