@@ -400,6 +400,14 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                 if max_steps is not None and steps >= max_steps:
                     done = True
                     break
+            # epoch end is also an aligned loop point every host reaches —
+            # without this check a run whose epoch is shorter than log_every
+            # ignores a stop signal for ⌈log_every/steps_per_epoch⌉ epochs
+            if not done and stopper.agreed():
+                done = True
+                if jax.process_index() == 0:
+                    print_log(f"stop signal at epoch {epoch:4d} end — "
+                              "evaluating, checkpointing, exiting", log)
             loss_rec = float(loss_rec_dev)
 
             # -- evaluate: global-mean loss per batch, mean over batches --------

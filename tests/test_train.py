@@ -152,41 +152,71 @@ def test_checkpoint_swap_crash_recovers_from_old(tmp_path):
     np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(4))
 
 
-def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
-    """SIGTERM mid-training → the loop finishes the step, evaluates, saves
-    both checkpoints, and run() returns normally (a hard kill would lose the
-    epoch AND can wedge a remote TPU's session claim)."""
+def _sigterm_when(log_path, needle, timeout_s=120):
+    """Background thread: SIGTERM this process once `needle` appears in the
+    train log. The needle must be a line the trainer only writes AFTER the
+    graceful handler is installed ("steps:"/"epoch:"; "TrainSet" is logged
+    before it — a signal there would kill the interpreter)."""
     import os as _os
     import signal
     import threading
     import time
 
-    from ddim_cold_tpu.train.trainer import run
-
-    base = str(tmp_path)
-    cfg = load_config(_write_config(base, synthetic_image_dir, epoch=[0, 200]),
-                      "exp")
-    log_path = os.path.join(base, "Saved_Models", cfg.run_name, "train.log")
-
-    def send_sigterm_once_training_started():
-        deadline = time.time() + 120
+    def watch():
+        deadline = time.time() + timeout_s
         while time.time() < deadline:
             try:
-                if "steps:" in open(log_path).read():
+                if needle in open(log_path).read():
                     _os.kill(_os.getpid(), signal.SIGTERM)
                     return
             except OSError:
                 pass
             time.sleep(0.25)
 
-    t = threading.Thread(target=send_sigterm_once_training_started, daemon=True)
+    t = threading.Thread(target=watch, daemon=True)
     t.start()
+    return t
+
+
+def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
+    """SIGTERM mid-training → the loop finishes the step, evaluates, saves
+    both checkpoints, and run() returns normally (a hard kill would lose the
+    epoch AND can wedge a remote TPU's session claim)."""
+    from ddim_cold_tpu.train.trainer import run
+
+    base = str(tmp_path)
+    cfg = load_config(_write_config(base, synthetic_image_dir, epoch=[0, 200]),
+                      "exp")
+    log_path = os.path.join(base, "Saved_Models", cfg.run_name, "train.log")
+    t = _sigterm_when(log_path, "steps:")
     result = run(cfg, base, log_every=1)  # returns instead of dying
     t.join()
     assert result.steps < 200 * 5  # stopped early
     assert np.isfinite(result.last_val_loss)
     log = open(log_path).read()
     assert "stop signal at step" in log
+    assert os.path.isdir(os.path.join(result.run_dir, "lastepoch.ckpt"))
+
+
+def test_sigterm_with_short_epochs_stops_at_epoch_end(tmp_path,
+                                                      synthetic_image_dir):
+    """A stop signal must take effect at the next EPOCH boundary even when
+    epochs are shorter than log_every — observed on a 16-step/epoch run with
+    log_every=100, where the in-epoch check (steps % log_every) never fired
+    and the signal was ignored for ~6 epochs."""
+    from ddim_cold_tpu.train.trainer import run
+
+    base = str(tmp_path)
+    cfg = load_config(_write_config(base, synthetic_image_dir, epoch=[0, 50]),
+                      "exp")
+    log_path = os.path.join(base, "Saved_Models", cfg.run_name, "train.log")
+    # signal lands during epoch 1 (after epoch 0's eval line, handler live);
+    # log_every=1000 >> the 5 steps/epoch: only the epoch-end check can stop
+    t = _sigterm_when(log_path, "epoch:")
+    result = run(cfg, base, log_every=1000)
+    t.join()
+    assert result.steps <= 3 * 5, "stop signal ignored past the next epoch end"
+    assert "stop signal at epoch" in open(log_path).read()
     assert os.path.isdir(os.path.join(result.run_dir, "lastepoch.ckpt"))
 
 
