@@ -20,7 +20,10 @@ kernels — dq (grid like the forward) and dk/dv (grid transposed: K/V blocks
 outer, q chunks streamed innermost) — that rebuild probabilities from the
 saved lse chunk by chunk, so the O(N²) matrix never exists in HBM in either
 direction. Residuals are (q, k, v, o, lse): O(N·D) — the whole train-step
-memory story for long sequences is bounded.
+memory story for long sequences is bounded. (In-kernel, lse rides a
+128-lane-replicated layout because TPU tiling rejects (1, bq) row blocks;
+the replication is sliced off / re-broadcast outside the kernels so the
+residual itself stays one lane. See _fwd_kernel._emit.)
 
 On non-TPU backends the kernels run in interpreter mode, so tests exercise
 the identical code paths on CPU (GPU falls back to the dense einsum).
@@ -82,7 +85,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m = jnp.max(m_ref[...], axis=-1, keepdims=True)
         l = jnp.max(l_ref[...], axis=-1, keepdims=True)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l))[:, 0]
+        # lane-replicated (bq, LANE): a (1, bq) row block would violate the
+        # TPU (8, 128) tile rule — Mosaic rejects sublane-dim-1 blocks unless
+        # they equal the array dim (hit at N=2501 on real hardware)
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape[1:])
 
 
 def _sds(shape, dtype, like: jax.Array) -> jax.ShapeDtypeStruct:
@@ -166,11 +172,11 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _sds(qh.shape, q.dtype, qh),
-            _sds(qh.shape[:2], jnp.float32, qh),
+            _sds((*qh.shape[:2], _LANE), jnp.float32, qh),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
@@ -184,7 +190,9 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
     )(qh, kh, vh)
 
     out = out[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)
-    return out, lse
+    # drop the lane replication before the lse becomes a VJP residual —
+    # carrying all 128 lanes would hold O(N·128) f32 across the backward
+    return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -205,8 +213,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k = k_ref[0].astype(jnp.float32)    # (bkv, D)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)  # (bq, D)
-    lse = lse_ref[0]                    # (bq,)
-    delta = delta_ref[0]                # (bq,)
+    lse = lse_ref[0][:, :1]             # (bq, 1), lane-replicated block
+    delta = delta_ref[0][:, :1]         # (bq, 1)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -217,9 +225,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     row = pl.program_id(1) * block_q + jax.lax.broadcasted_iota(
         jnp.int32, logits.shape, 0)
     p = jnp.where((col < n_valid) & (row < n_valid),
-                  jnp.exp(logits - lse[:, None]), 0.0)
+                  jnp.exp(logits - lse), 0.0)
     dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     acc_ref[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
 
     @pl.when(kv_i == n_kv - 1)
@@ -244,8 +252,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0].astype(jnp.float32)    # (bkv, D)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)  # (bq, D)
-    lse = lse_ref[0]                    # (bq,)
-    delta = delta_ref[0]                # (bq,)
+    lse = lse_ref[0][:, :1]             # (bq, 1), lane-replicated block
+    delta = delta_ref[0][:, :1]         # (bq, 1)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -256,11 +264,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     col = pl.program_id(1) * block_kv + jax.lax.broadcasted_iota(
         jnp.int32, logits.shape, 1)
     p = jnp.where((row < n_valid) & (col < n_valid),
-                  jnp.exp(logits - lse[:, None]), 0.0)
+                  jnp.exp(logits - lse), 0.0)
     dv_acc[...] += jax.lax.dot_general(  # pᵀ·do: (bkv, D)
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     dk_acc[...] += jax.lax.dot_general(  # dsᵀ·q: (bkv, D)
         ds, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -280,13 +288,18 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
     qh, oh, gh = (_pad_to(x, 1, bq) for x in (qh, oh, gh))
     kh, vh = _pad_to(kh, 1, bkv), _pad_to(vh, 1, bkv)
     n_q, n_kv = qh.shape[1] // bq, kh.shape[1] // bkv
-    lse = _pad_to(lse, 1, bq)  # (BH, Nq⁺), from the forward kernel
+    # lse (BH, Nq⁺) and delta get lane-replicated to (…, LANE) blocks here —
+    # sublane-dim-1 (1, bq) row blocks don't lower on TPU (the (8, 128) tile
+    # rule); the broadcast is per-backward-call, so the residual stays O(N)
+    lse = _pad_to(lse, 1, bq)
+    lse = jnp.broadcast_to(lse[:, :, None], (*lse.shape, _LANE))
     delta = jnp.sum(oh.astype(jnp.float32) * gh.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None], (*delta.shape, _LANE))
 
     interpret = jax.default_backend() == "cpu"
     q_spec = pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0))
     kv_spec_dq = pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, n_valid=N,
@@ -304,7 +317,7 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
     # transposed grid: (head, kv block, q chunk innermost)
     q_spec_t = pl.BlockSpec((1, bq, Dp), lambda b, j, i: (b, i, 0))
     kv_spec_t = pl.BlockSpec((1, bkv, Dp), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    row_spec_t = pl.BlockSpec((1, bq, _LANE), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, n_valid=N,
                           block_q=bq, block_kv=bkv, n_q=n_q),
