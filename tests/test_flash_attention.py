@@ -134,3 +134,58 @@ def test_model_attention_probe_still_works_with_flash():
     assert attn.shape == (1, 4, 17, 17)
     s = np.asarray(jnp.sum(attn, axis=-1))
     np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
+
+
+def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
+    """Every BlockSpec the kernels build must satisfy Mosaic's TPU tiling
+    rule: the last two dims of a block are divisible by (8, 128) or equal
+    the array's. CPU interpret mode never enforces this, which let a
+    (1, bq) lse row block ship and fail to compile on real hardware at the
+    200px config (N=2501, BH=64) — this guard reproduces the check the TPU
+    lowering applies, against the real pallas_call arguments."""
+    from jax.experimental import pallas as pl
+
+    from ddim_cold_tpu.ops import flash_attention as fa
+
+    def check(block, arr, ctx):
+        assert len(block) == len(arr), (ctx, block, arr)
+        if len(block) < 2:
+            return
+        (bs, bl), (asub, alane) = block[-2:], arr[-2:]
+        assert bs % 8 == 0 or bs == asub, (ctx, block, arr)
+        assert bl % 128 == 0 or bl == alane, (ctx, block, arr)
+
+    real = pl.pallas_call
+    calls = []
+
+    def spy(kernel, **kw):
+        inner = real(kernel, **kw)
+
+        def wrapper(*ops):
+            name = getattr(kernel, "func", kernel).__name__
+            calls.append(name)
+            in_specs = kw["in_specs"]
+            for i, (spec, op) in enumerate(zip(in_specs, ops)):
+                check(spec.block_shape, op.shape, f"{name} in[{i}]")
+            outs = kw["out_shape"]
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            specs = kw["out_specs"]
+            specs = specs if isinstance(specs, (list, tuple)) else [specs]
+            for i, (spec, o) in enumerate(zip(specs, outs)):
+                check(spec.block_shape, o.shape, f"{name} out[{i}]")
+            return inner(*ops)
+
+        return wrapper
+
+    monkeypatch.setattr(fa.pl, "pallas_call", spy)
+    # 65 = vit_tiny, 257 = oxford_flower_64, 2501 = the 200px north-star
+    # shape that failed on hardware (keep it last: largest)
+    for N, H, D in ((65, 12, 32), (257, 4, 64), (2501, 4, 64)):
+        q, k, v = _rand_qkv(7, 1, N, H, D)
+        scale = D**-0.5
+        out = flash_attention(q, k, v, scale)
+        assert np.isfinite(np.asarray(out)).all()
+        g = jax.grad(lambda q: flash_attention(q, k, v, scale).sum())(q)
+        assert np.isfinite(np.asarray(g)).all()
+    # per shape: primal fwd + vjp fwd + dq + dkv
+    assert calls.count("_fwd_kernel") == 6 and len(calls) == 12, calls
