@@ -81,6 +81,8 @@ def render_samples(run_dir: str, out_dir: str, *, n: int = 16):
     """Grids from the run's best checkpoint: DDIM samples + the 6-step cold
     sequence (the reference's two acceptance figures, ViT.py:283-305,
     ViT_draft2drawing.py:364-376)."""
+    import math
+
     import jax
     import numpy as np
 
@@ -88,16 +90,19 @@ def render_samples(run_dir: str, out_dir: str, *, n: int = 16):
     from ddim_cold_tpu.utils.image import save_grid
     from ddim_cold_tpu.utils.run_io import load_run
 
-    _, model, params = load_run(run_dir)
+    config, model, params = load_run(run_dir)
 
-    # cold-model grids: the 6-step cold sampler is the trained regime
+    # cold-model grids in the run's trained regime: t ∈ [1, log2(H)] —
+    # 6 levels for 64px, 7 for the 200px config (same rule as compute_fid)
+    levels = int(math.log2(config.image_size[0]))
     side = int(np.sqrt(n))
     cold = np.asarray(sampling.cold_sample(
-        model, params, jax.random.PRNGKey(0), n=side * side))
+        model, params, jax.random.PRNGKey(0), n=side * side, levels=levels))
     save_grid(cold, os.path.join(out_dir, "samples.png"),
               nrows=side, ncols=side)
     seq = np.asarray(sampling.cold_sample(
-        model, params, jax.random.PRNGKey(1), n=4, return_sequence=True))
+        model, params, jax.random.PRNGKey(1), n=4, levels=levels,
+        return_sequence=True))
     # (levels, n, H, W, C) → rows = sample, cols = denoising level
     frames = seq.transpose(1, 0, 2, 3, 4).reshape(-1, *seq.shape[-3:])
     save_grid(frames, os.path.join(out_dir, "cold_sequence.png"),

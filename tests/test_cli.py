@@ -119,3 +119,53 @@ def test_draft2drawing_img2tensor_range(synthetic_image_dir):
     x = np.asarray(d2d.img2tensor(os.path.join(synthetic_image_dir, "0.jpg"), (16, 16)))
     assert x.shape == (1, 16, 16, 3)
     assert x.min() >= -1.0 and x.max() <= 1.0
+
+
+def test_publish_run_levels_follow_run_config(monkeypatch, tmp_path,
+                                              synthetic_image_dir):
+    """scripts/publish_run.py on a finished run dir: artifacts appear and the
+    cold-sample grids use the run's OWN level count (t ∈ [1, log2(H)]) — a
+    200px run must publish 7-level sequences, not the 64px default of 6
+    (the rule compute_fid/fid_trend already apply)."""
+    import importlib.util as ilu
+
+    import yaml
+
+    cfg = dict(
+        initializing="none", resume="none", AMP=False, framework="smoke",
+        num_gpus=1, batch_size=2, epoch=[0, 1], base_lr=0.005,
+        dataStorage=[synthetic_image_dir, synthetic_image_dir],
+        image_size=[16, 16], diff_step=4, patch_size=8, embed_dim=32,
+        depth=2, head=4,
+    )
+    with open(tmp_path / "exp.yaml", "w") as f:
+        yaml.safe_dump(cfg, f)
+    monkeypatch.chdir(tmp_path)
+    trainer = _load("multi_gpu_trainer")
+    assert trainer.main(["multi_gpu_trainer.py", "exp"], base_dir=str(tmp_path)) == 0
+    run_dir = tmp_path / "Saved_Models" / "expsmoke"
+
+    spec = ilu.spec_from_file_location(
+        "publish_run", os.path.join(REPO, "scripts", "publish_run.py"))
+    pub = ilu.module_from_spec(spec)
+    spec.loader.exec_module(pub)
+    monkeypatch.setattr(pub, "REPO", str(tmp_path))
+
+    seen_levels = []
+    from ddim_cold_tpu.ops import sampling
+
+    real_cold = sampling.cold_sample
+
+    def spy(model, params, rng, **kw):
+        seen_levels.append(kw.get("levels", 6))
+        return real_cold(model, params, rng, **kw)
+
+    monkeypatch.setattr(sampling, "cold_sample", spy)
+    pub.main([str(run_dir), "--cpu"])
+
+    out = tmp_path / "results" / "expsmoke"
+    for artifact in ("val_curve.png", "samples.png", "cold_sequence.png",
+                     "summary.json", "train.log"):
+        assert (out / artifact).is_file(), artifact
+    # 16px run → log2(16) = 4 levels, for the grid and the sequence alike
+    assert seen_levels == [4, 4], seen_levels
