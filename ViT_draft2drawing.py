@@ -15,6 +15,7 @@ upstream snapshot ships no weights), ``--draft`` to point at any sketch, and
 automatic TPU dispatch.
 """
 
+import math
 import os
 import sys
 
@@ -88,14 +89,18 @@ def main(config_name, checkpoint, init_random, draft, interpolate, cold_n, seed)
     print(f"devices: {jax.devices()}")
 
     # --- cold-diffusion sequence figure (reference :364-376) -----------------
+    # levels follow the model's own size (t ∈ [1, log2(H)]): 6 for the
+    # reference's 64px configs, 7 for 200px via the additive --config flag
+    levels = int(math.log2(model.img_size[0]))
     seq = sampling.cold_sample(model, params, jax.random.PRNGKey(seed),
-                               n=cold_n, return_sequence=True)
+                               n=cold_n, levels=levels, return_sequence=True)
     frames = jnp.swapaxes(seq, 0, 1).reshape(-1, *seq.shape[2:])
     out = save_grid(frames, get_next_path(os.path.join(saved, "cold_sequence.png")),
                     nrows=cold_n, ncols=seq.shape[0])
     print(f"wrote {out}")
 
-    grid = sampling.cold_sample(model, params, jax.random.PRNGKey(seed + 1), n=cold_n)
+    grid = sampling.cold_sample(model, params, jax.random.PRNGKey(seed + 1),
+                                n=cold_n, levels=levels)
     nrows, ncols = grid_shape(cold_n)
     out = save_grid(grid, get_next_path(os.path.join(saved, "cold_samples.png")),
                     nrows=nrows, ncols=ncols)
