@@ -33,6 +33,13 @@ else
   note "stage 2 FAILED rc=$?"
 fi
 
+note "stage 2b: on-chip flash/dense numerics (tpu_validate --no-bench)"
+if python scripts/tpu_validate.py --no-bench > results/tpu_validate_r03.txt 2>&1; then
+  note "stage 2b OK"
+else
+  note "stage 2b FAILED rc=$?"
+fi
+
 note "stage 3: 200px flash training run"
 if python multi_gpu_trainer.py 20220822_200px >> "$LOG" 2>&1; then
   if python scripts/publish_run.py Saved_Models/20220822_200pxflower200_diffusion >> "$LOG" 2>&1; then

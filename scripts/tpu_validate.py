@@ -19,6 +19,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="skip the 200px timings")
+    ap.add_argument("--no-bench", action="store_true",
+                    help="numerics only — skip the bench delegation (for a "
+                         "chain that runs bench.py separately)")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (script self-test; site config outranks "
                          "the JAX_PLATFORMS env var)")
@@ -86,12 +89,13 @@ def main():
             print(f"[sample] 200px k=100 N=4 flash={flash}: finite, in [0,1] OK")
 
     # -- 3. timing: delegate to bench.py (single source of timing truth) ---
-    import bench
+    if not args.no_bench:
+        import bench
 
-    bench_args = ["--smoke"] if args.quick else ["--ksweep"]
-    if args.cpu:
-        bench_args.append("--cpu")
-    bench.main(bench_args)
+        bench_args = ["--smoke"] if args.quick else ["--ksweep"]
+        if args.cpu:
+            bench_args.append("--cpu")
+        bench.main(bench_args)
 
     print("tpu_validate: ALL OK")
     return 0
