@@ -244,9 +244,12 @@ def main(argv=None):
         # the acceptance metric: 200px DDIM k=20 img/s/chip (BASELINE.json)
         n, k = 16, 20
         ns_params = None
+        flash_model = None
         for flash in (False, True):
             ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
                                     **MODEL_CONFIGS["oxford_flower_200_p4"])
+            if flash:
+                flash_model = ns_model
             if ns_params is None:
                 ns_params = ns_model.init(
                     jax.random.PRNGKey(0),
@@ -260,6 +263,23 @@ def main(argv=None):
                    sub["sampler_throughput_200px_k20_dense"]["value"])
         sub["sampler_throughput_200px_k20"] = {
             "value": best, "unit": "img/s/chip", "n": n, "k": k}
+        # best-achievable leg (separate submetric — the headline above stays
+        # pinned to the n=16 definition BASELINE.json publishes): flash never
+        # materializes the N² attention matrix (dense at N=2501 burns
+        # ~100 MB/img/layer on the f32 softmax, which is what pins the paired
+        # comparison at n=16), so the flash path can batch 4× higher — the
+        # throughput a user actually gets. Best-effort: a failure here (e.g.
+        # RESOURCE_EXHAUSTED on a smaller-HBM chip) must not flag the
+        # already-captured n=16 headline as a failed section.
+        n_big = 64
+        try:
+            sdt = time_ddim(flash_model, ns_params, k, n_big,
+                            f"north-star 200px flash n={n_big}")
+            sub["sampler_throughput_200px_k20_flash_n64"] = {
+                "value": round(n_big / sdt, 2), "unit": "img/s/chip",
+                "n": n_big, "k": k}
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            sub["northstar_n64_error"] = f"{type(e).__name__}: {e}"[:300]
 
     if not args.skip_northstar:
         section("northstar", run_northstar)
