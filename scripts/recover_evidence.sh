@@ -41,6 +41,13 @@ else
 fi
 
 note "stage 3: 200px flash training run"
+# 20220822_200px.yaml points at OxfordFlowers200/ — build it if absent
+# (smaller than the 64px set: the goal is a real run dir, not convergence)
+if [ ! -d OxfordFlowers200/train ] || [ ! -d OxfordFlowers200/val ]; then
+  note "stage 3: generating OxfordFlowers200 (4096 train / 512 val @ 200px)"
+  python scripts/make_dataset.py --out OxfordFlowers200 --size 200 \
+    --train 4096 --val 512 >> "$LOG" 2>&1 || note "stage 3 dataset gen FAILED rc=$?"
+fi
 if python multi_gpu_trainer.py 20220822_200px >> "$LOG" 2>&1; then
   if python scripts/publish_run.py Saved_Models/20220822_200pxflower200_diffusion >> "$LOG" 2>&1; then
     note "stage 3 OK"
