@@ -44,7 +44,9 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--skip-northstar", action="store_true")
     ap.add_argument("--skip-e2e", action="store_true")
-    ap.add_argument("--skip-scaling", action="store_true")
+    ap.add_argument("--skip-scaling", action="store_true",
+                    help="skip the batch-scaling sweep AND the scan_blocks "
+                         "depth-layout comparison")
     ap.add_argument("--skip-sampler", action="store_true",
                     help="skip the 64px sampler section (CI smoke)")
     ap.add_argument("--ksweep", action=argparse.BooleanOptionalAction,
@@ -131,27 +133,28 @@ def main(argv=None):
                                total_steps=51200, sample_batch=batch)
     train_step = make_train_step(model)
 
-    def time_train(st, bt, steps):
+    def time_train(st, bt, steps, step=None):
         """Compile, settle, then time `steps` steps as TWO windows and keep
         the faster — a transient tunnel stall inside one window (the likely
         cause of r03's anomalous b64 batch-scaling row) then costs half the
         steps, not the whole measurement. Syncs go through float()/np.asarray
         — a real D2H transfer — because block_until_ready can return early
         through the remote-TPU tunnel, silently timing only the dispatch."""
+        step = step or train_step
         ema = jnp.float32(5.0)
         t0 = time.time()
-        st, _, ema = train_step(st, bt, jax.random.PRNGKey(1), ema)
+        st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
         float(ema)
         compile_s = time.time() - t0
         for _ in range(3):
-            st, _, ema = train_step(st, bt, jax.random.PRNGKey(1), ema)
+            st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
         float(ema)
         per = max(1, steps // 2)
         best = float("inf")
         for _ in range(2):
             t0 = time.time()
             for _ in range(per):
-                st, _, ema = train_step(st, bt, jax.random.PRNGKey(1), ema)
+                st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
             float(ema)
             best = min(best, (time.time() - t0) / per)
         return st, best, compile_s
@@ -196,6 +199,30 @@ def main(argv=None):
 
     if not args.skip_scaling:
         section("batch_scaling", run_scaling)
+
+    # ----------------------------------------------------------- scan_blocks
+    def run_scan_blocks():
+        # measured basis for the PERF.md compile-vs-step decision: the same
+        # headline step with depth under nn.scan (stacked params, one
+        # compiled block body) vs the unrolled headline above
+        sc_model = DiffusionViT(dtype=jnp.bfloat16, scan_blocks=True,
+                                **MODEL_CONFIGS["vit_tiny"])
+        st = create_train_state(sc_model, jax.random.PRNGKey(0), lr=2e-4,
+                                total_steps=51200, sample_batch=batch)
+        _, sp, comp = time_train(st, batch, max(10, args.steps // 2),
+                                 step=make_train_step(sc_model))
+        sub["scan_blocks"] = {
+            "batch": B,
+            "ms_per_step": round(1000 * sp, 3),
+            "img_per_sec": round(B / sp, 1),
+            "compile_s": round(comp, 1),
+            "unrolled_ms_per_step": round(1000 * spi, 3),
+            "unrolled_compile_s": round(compile_s, 1)}
+        log(f"scan_blocks b{B}: {1000*sp:.2f} ms/step (compile {comp:.1f}s) "
+            f"vs unrolled {1000*spi:.2f} ms/step (compile {compile_s:.1f}s)")
+
+    if not args.skip_scaling:  # --skip-scaling drops both depth-layout rows
+        section("scan_blocks", run_scan_blocks)
 
     # ------------------------------------------------------------- samplers
     def time_ddim(smodel, sparams, k, n, label):
