@@ -28,9 +28,15 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
+
+#: e2e's generated temp dataset, registered so a watchdog abort (os._exit
+#: skips every finally) can still remove it instead of leaking 4096 images
+#: into /tmp per wedged round on the shared bench host
+_E2E_TMP = {"path": None}
 
 
 def main(argv=None):
@@ -100,235 +106,365 @@ def main(argv=None):
     if args.ksweep is None:  # default: full runs sweep, smoke doesn't —
         args.ksweep = not args.smoke  # an explicit flag wins either way
 
-    chip = jax.devices()[0].device_kind
-    peak = flops_util.peak_tflops(chip)
     sub = {}
-    if platform_fallback:
-        sub["platform_fallback"] = f"ran on cpu — {platform_fallback}"
-    if jax.default_backend() == "cpu":
-        try:  # CPU numbers are only honest on an uncontended box — record it
-            load1 = os.getloadavg()[0]
-            if load1 > 0.8 * (os.cpu_count() or 1):
-                sub["cpu_contention"] = (
-                    f"1-min loadavg {load1:.2f} on {os.cpu_count()} core(s) — "
-                    "another process shares the CPU; timings are pessimistic")
-        except OSError:
-            pass
-
-    def log(msg):
-        print(f"[bench] {msg}", file=sys.stderr)
-
-    # ------------------------------------------------------------------ train
-    model = DiffusionViT(dtype=jnp.bfloat16, **MODEL_CONFIGS["vit_tiny"])
-    rng = np.random.RandomState(0)
-    B = args.batch
-    def synth_batch(b):
-        return (
-            jnp.asarray(rng.randn(b, 64, 64, 3), jnp.float32),
-            jnp.asarray(rng.randn(b, 64, 64, 3), jnp.float32),
-            jnp.asarray(rng.randint(1, 7, size=(b,)), jnp.int32),
-        )
-    batch = synth_batch(B)
-    state = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
-                               total_steps=51200, sample_batch=batch)
-    train_step = make_train_step(model)
-
-    def time_train(st, bt, steps, step=None):
-        """Compile, settle, then time `steps` steps as TWO windows and keep
-        the faster — a transient tunnel stall inside one window (the likely
-        cause of r03's anomalous b64 batch-scaling row) then costs half the
-        steps, not the whole measurement. Syncs go through float()/np.asarray
-        — a real D2H transfer — because block_until_ready can return early
-        through the remote-TPU tunnel, silently timing only the dispatch."""
-        step = step or train_step
-        ema = jnp.float32(5.0)
-        t0 = time.time()
-        st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
-        float(ema)
-        compile_s = time.time() - t0
-        for _ in range(3):
-            st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
-        float(ema)
-        per = max(1, steps // 2)
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.time()
-            for _ in range(per):
-                st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
-            float(ema)
-            best = min(best, (time.time() - t0) / per)
-        return st, best, compile_s
-
-    state, spi, compile_s = time_train(state, batch, args.steps)
-    img_per_sec = B / spi
-    step_flops = flops_util.train_step_flops(
-        B, mlp_ratio=1.0, **MODEL_CONFIGS["vit_tiny"])
-    train_mfu = flops_util.mfu(step_flops, spi, chip)
-    log(f"platform={jax.default_backend()} chip={chip!r} "
-        f"peak_bf16={peak} TFLOP/s compile={compile_s:.1f}s "
-        f"{args.steps} steps @ b{B}: {1000*spi:.2f} ms/step "
-        f"({img_per_sec:.0f} img/s, mfu={train_mfu if train_mfu is None else round(train_mfu, 4)})")
-
-    def section(name, fn):
-        """Sections after the headline are best-effort: a failure (OOM on a
-        small chip, missing native lib, …) records an error string instead of
-        losing the whole BENCH record."""
-        try:
-            fn()
-        except Exception as e:  # noqa: BLE001 — deliberate catch-all
-            log(f"{name} section failed: {type(e).__name__}: {e}")
-            sub[name + "_error"] = f"{type(e).__name__}: {e}"
-
-    # --------------------------------------------------------- batch scaling
-    def run_scaling():
-        rows = []
-        for b in (64, 128, 256):
-            bt = synth_batch(b)
-            st = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
-                                    total_steps=51200, sample_batch=bt)
-            st, sp, _ = time_train(st, bt, max(10, args.steps // 2))
-            fl = flops_util.train_step_flops(b, mlp_ratio=1.0,
-                                             **MODEL_CONFIGS["vit_tiny"])
-            m = flops_util.mfu(fl, sp, chip)
-            rows.append({"batch": b, "ms_per_step": round(1000 * sp, 3),
-                         "img_per_sec": round(b / sp, 1),
-                         "mfu": None if m is None else round(m, 4)})
-            log(f"scaling b{b}: {1000*sp:.2f} ms/step ({b/sp:.0f} img/s, "
-                f"mfu={rows[-1]['mfu']})")
-        sub["batch_scaling"] = rows
-
-    if not args.skip_scaling:
-        section("batch_scaling", run_scaling)
-
-    # ----------------------------------------------------------- scan_blocks
-    def run_scan_blocks():
-        # measured basis for the PERF.md compile-vs-step decision: the same
-        # headline step with depth under nn.scan (stacked params, one
-        # compiled block body) vs the unrolled headline above
-        sc_model = DiffusionViT(dtype=jnp.bfloat16, scan_blocks=True,
-                                **MODEL_CONFIGS["vit_tiny"])
-        st = create_train_state(sc_model, jax.random.PRNGKey(0), lr=2e-4,
-                                total_steps=51200, sample_batch=batch)
-        _, sp, comp = time_train(st, batch, max(10, args.steps // 2),
-                                 step=make_train_step(sc_model))
-        sub["scan_blocks"] = {
-            "batch": B,
-            "ms_per_step": round(1000 * sp, 3),
-            "img_per_sec": round(B / sp, 1),
-            "compile_s": round(comp, 1),
-            "unrolled_ms_per_step": round(1000 * spi, 3),
-            "unrolled_compile_s": round(compile_s, 1)}
-        log(f"scan_blocks b{B}: {1000*sp:.2f} ms/step (compile {comp:.1f}s) "
-            f"vs unrolled {1000*spi:.2f} ms/step (compile {compile_s:.1f}s)")
-
-    if not args.skip_scaling:  # --skip-scaling drops both depth-layout rows
-        section("scan_blocks", run_scan_blocks)
-
-    # ------------------------------------------------------------- samplers
-    def time_ddim(smodel, sparams, k, n, label):
-        """Compile+sync one sampling run, then time TWO and keep the faster
-        (one transient tunnel stall must not poison the record) — syncing via
-        a real host transfer (see time_train). Memoized per (model, k, n)."""
-        from ddim_cold_tpu.ops import sampling
-
-        key = (id(smodel), k, n)
-        if key not in timed:
-            img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(2), k=k, n=n)
-            np.asarray(img)
-            best = float("inf")
-            for seed in (3, 4):
-                t0 = time.time()
-                img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(seed), k=k, n=n)
-                np.asarray(img)
-                best = min(best, time.time() - t0)
-            timed[key] = best
-        sdt = timed[key]
-        log(f"{label} DDIM k={k:3d} N={n}: {sdt:6.2f}s → {n/sdt:8.2f} img/s/chip")
-        return sdt
-
-    timed = {}
-    n_sample = 8 if args.smoke else 64
-
-    def run_sampler64():
-        k20 = time_ddim(model, state.params, 20, n_sample, "vit_tiny 64px")
-        sub["sampler_throughput_64px_k20"] = {
-            "value": round(n_sample / k20, 2), "unit": "img/s/chip"}
-
-    if not args.skip_sampler:
-        section("sampler_64px", run_sampler64)
-
-    def run_ksweep():
-        sweep = {}
-        for k in (5, 20, 50) if args.smoke else (1, 5, 20, 50):
-            sweep[str(k)] = round(
-                n_sample / time_ddim(model, state.params, k, n_sample, "k-sweep"), 2)
-        sub["ksweep_64px_img_per_sec"] = sweep
-
-    if args.ksweep:
-        section("ksweep", run_ksweep)
-
-    def run_northstar():
-        # the acceptance metric: 200px DDIM k=20 img/s/chip (BASELINE.json)
-        n, k = 16, 20
-        ns_params = None
-        flash_model = None
-        for flash in (False, True):
-            ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
-                                    **MODEL_CONFIGS["oxford_flower_200_p4"])
-            if flash:
-                flash_model = ns_model
-            if ns_params is None:
-                ns_params = ns_model.init(
-                    jax.random.PRNGKey(0),
-                    jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
-            sdt = time_ddim(ns_model, ns_params, k, n,
-                            f"north-star 200px flash={int(flash)}")
-            sub["sampler_throughput_200px_k20" + ("_flash" if flash else "_dense")] = {
-                "value": round(n / sdt, 2), "unit": "img/s/chip", "n": n, "k": k}
-        # headline north-star alias = the faster of the two attention paths
-        best = max(sub["sampler_throughput_200px_k20_flash"]["value"],
-                   sub["sampler_throughput_200px_k20_dense"]["value"])
-        sub["sampler_throughput_200px_k20"] = {
-            "value": best, "unit": "img/s/chip", "n": n, "k": k}
-        # best-achievable leg (separate submetric — the headline above stays
-        # pinned to the n=16 definition BASELINE.json publishes): flash never
-        # materializes the N² attention matrix (dense at N=2501 burns
-        # ~100 MB/img/layer on the f32 softmax, which is what pins the paired
-        # comparison at n=16), so the flash path can batch 4× higher — the
-        # throughput a user actually gets. Best-effort: a failure here (e.g.
-        # RESOURCE_EXHAUSTED on a smaller-HBM chip) must not flag the
-        # already-captured n=16 headline as a failed section.
-        n_big = 64
-        try:
-            sdt = time_ddim(flash_model, ns_params, k, n_big,
-                            f"north-star 200px flash n={n_big}")
-            sub["sampler_throughput_200px_k20_flash_n64"] = {
-                "value": round(n_big / sdt, 2), "unit": "img/s/chip",
-                "n": n_big, "k": k}
-        except Exception as e:  # noqa: BLE001 — recorded, never fatal
-            sub["northstar_n64_error"] = f"{type(e).__name__}: {e}"[:300]
-
-    if not args.skip_northstar:
-        section("northstar", run_northstar)
-
-    # ------------------------------------------------- e2e with the data path
-    if not args.skip_e2e:
-        section("e2e", lambda: sub.update(_bench_e2e(args, model, state, log)))
-
-    print(json.dumps({
+    # The record is assembled INCREMENTALLY and the watchdog below can emit it
+    # mid-run: on the remote-TPU tunnel a dropped connection leaves the next
+    # XLA RPC blocked forever with no exception to catch (observed r03:
+    # 0% CPU, one half-open socket). A bench that hangs until an outer kill
+    # records nothing — and killing a client that holds the chip grant is
+    # itself what wedges the tunnel (utils/platform.py). Emitting the partial
+    # record and exiting is strictly better on both axes.
+    record = {
         "metric": "train_throughput_vit_tiny64_b32",
-        "value": round(img_per_sec, 1),
+        "value": None,
         "unit": "img/s",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "vs_baseline": None,
         "baseline": {"value": BASELINE_IMG_PER_SEC, "unit": "img/s",
                      "hardware": "RTX 3090 (train.log, torch AMP)"},
-        "chip": chip,
+        "chip": None,
         "n_devices": 1,
-        "peak_bf16_tflops": peak,
-        "ms_per_step": round(1000 * spi, 3),
-        "mfu": None if train_mfu is None else round(train_mfu, 4),
+        "peak_bf16_tflops": None,
+        "ms_per_step": None,
+        "mfu": None,
         "submetrics": sub,
-    }))
+    }
+    progress = {"t": time.time(), "label": "backend init", "done": False}
+
+    def mark(label):
+        progress["t"], progress["label"] = time.time(), label
+
+    # Default: armed only when an accelerator platform is CONFIGURED — read
+    # from jax.config, not a backend query: the watchdog must be running
+    # before this process's own first jax.devices(), which is exactly the
+    # call that blocks forever on a wedged tunnel (utils/platform.py; the
+    # subprocess probe above claims and releases in a DIFFERENT process, so
+    # a drop in the gap between probe and here still wedges us). A local cpu
+    # backend has no tunnel to wedge, and healthy CPU runs of the heavy
+    # sections blow any sane deadline (tpu_validate --cpu runs the full
+    # bench). An explicit env value always wins (tests arm it on cpu;
+    # 0 disables anywhere).
+    from ddim_cold_tpu.utils.platform import effective_first_platform
+
+    # empty string counts as unset (a yaml/CI "unset" idiom); 1800s default:
+    # generous against legitimately slow markless windows (a big compile, one
+    # e2e epoch) while still bounding a wedge well inside driver patience
+    env_stall = os.environ.get("DDIM_COLD_BENCH_STALL_S") or None
+    stall_s = (float(env_stall) if env_stall is not None
+               else 0.0 if effective_first_platform() == "cpu" else 1800.0)
+
+    def _watchdog():
+        emit_failures = 0
+        while not (progress["done"] or progress.get("disarmed")):
+            time.sleep(min(15.0, max(0.2, stall_s / 4)))  # outlive main()
+            idle = time.time() - progress["t"]
+            if progress["done"] or idle <= stall_s:
+                continue
+            try:
+                # snapshot: the main thread may mutate sub mid-serialization
+                snap = dict(record, submetrics=dict(
+                    sub,
+                    aborted=f"no progress for {idle:.0f}s after "
+                            f"{progress['label']!r} — RPC wedged mid-run; "
+                            "partial record emitted (raise "
+                            "DDIM_COLD_BENCH_STALL_S to wait longer)"))
+                print(json.dumps(snap))
+                sys.stdout.flush()
+            except Exception:  # noqa: BLE001 — retry a transient emit race,
+                # but NEVER loop forever: a process that can't emit (harness
+                # closed stdout) must still exit rather than sit wedged
+                # holding the chip grant indefinitely
+                emit_failures += 1
+                if emit_failures < 3:
+                    continue
+            # best-effort cleanup _exit would otherwise skip (pure fs work,
+            # safe from this thread): the generated e2e dataset in /tmp
+            if _E2E_TMP["path"]:
+                shutil.rmtree(_E2E_TMP["path"], ignore_errors=True)
+            # _exit, nonzero: the record is out (or unemittable), callers
+            # must not log the partial run as success — and no signal ever
+            # reaches another client holding the chip grant
+            os._exit(3)
+
+    if stall_s > 0:
+        threading.Thread(target=_watchdog, daemon=True).start()
+    # everything below runs under the armed watchdog: the finally guarantees
+    # it dies with main() even on an exception, so an in-process caller that
+    # catches the exception is never os._exit'd by an orphaned watchdog
+    # later (tpu_validate, pytest)
+    try:
+        hang_s = float(os.environ.get("DDIM_COLD_BENCH_TEST_HANG_S", "0"))
+        if hang_s:  # test hook: a wedged RPC = blocked, no progress marks
+            time.sleep(hang_s)
+        # first in-process backend touch — THE call that blocks forever on a
+        # wedged tunnel; the armed watchdog above is what bounds it
+        chip = jax.devices()[0].device_kind
+        peak = flops_util.peak_tflops(chip)
+        record.update(chip=chip, peak_bf16_tflops=peak)
+        mark("backend up")
+        if env_stall is None and jax.default_backend() == "cpu":
+            # platform was auto-DETECTED as cpu (nothing configured, no env
+            # override): same reasoning as the configured-cpu default above —
+            # no tunnel to wedge, and heavy sections legitimately run for
+            # hours on cpu. Disarm before they start.
+            progress["disarmed"] = True
+        if platform_fallback:
+            sub["platform_fallback"] = f"ran on cpu — {platform_fallback}"
+        if jax.default_backend() == "cpu":
+            try:  # CPU numbers are only honest on an uncontended box — record it
+                load1 = os.getloadavg()[0]
+                if load1 > 0.8 * (os.cpu_count() or 1):
+                    sub["cpu_contention"] = (
+                        f"1-min loadavg {load1:.2f} on {os.cpu_count()} core(s) — "
+                        "another process shares the CPU; timings are pessimistic")
+            except OSError:
+                pass
+
+        def log(msg):
+            mark(str(msg)[:100])  # every log line is a liveness beacon
+            print(f"[bench] {msg}", file=sys.stderr)
+
+        # ------------------------------------------------------------------ train
+        model = DiffusionViT(dtype=jnp.bfloat16, **MODEL_CONFIGS["vit_tiny"])
+        rng = np.random.RandomState(0)
+        B = args.batch
+        def synth_batch(b):
+            return (
+                jnp.asarray(rng.randn(b, 64, 64, 3), jnp.float32),
+                jnp.asarray(rng.randn(b, 64, 64, 3), jnp.float32),
+                jnp.asarray(rng.randint(1, 7, size=(b,)), jnp.int32),
+            )
+        batch = synth_batch(B)
+        state = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
+                                   total_steps=51200, sample_batch=batch)
+        train_step = make_train_step(model)
+
+        def time_train(st, bt, steps, step=None):
+            """Compile, settle, then time `steps` steps as TWO windows and keep
+            the faster — a transient tunnel stall inside one window (the likely
+            cause of r03's anomalous b64 batch-scaling row) then costs half the
+            steps, not the whole measurement. Syncs go through float()/np.asarray
+            — a real D2H transfer — because block_until_ready can return early
+            through the remote-TPU tunnel, silently timing only the dispatch."""
+            step = step or train_step
+            mark(f"train-step compile b{bt[0].shape[0]}")  # pre-compile beacon:
+            ema = jnp.float32(5.0)  # the compile itself emits no progress
+            t0 = time.time()
+            st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
+            float(ema)
+            compile_s = time.time() - t0
+            for _ in range(3):
+                st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
+            float(ema)
+            per = max(1, steps // 2)
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.time()
+                for _ in range(per):
+                    st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
+                float(ema)
+                best = min(best, (time.time() - t0) / per)
+            return st, best, compile_s
+
+        state, spi, compile_s = time_train(state, batch, args.steps)
+        img_per_sec = B / spi
+        step_flops = flops_util.train_step_flops(
+            B, mlp_ratio=1.0, **MODEL_CONFIGS["vit_tiny"])
+        train_mfu = flops_util.mfu(step_flops, spi, chip)
+        record.update(
+            value=round(img_per_sec, 1),
+            vs_baseline=round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+            ms_per_step=round(1000 * spi, 3),
+            mfu=None if train_mfu is None else round(train_mfu, 4))
+        log(f"platform={jax.default_backend()} chip={chip!r} "
+            f"peak_bf16={peak} TFLOP/s compile={compile_s:.1f}s "
+            f"{args.steps} steps @ b{B}: {1000*spi:.2f} ms/step "
+            f"({img_per_sec:.0f} img/s, mfu={train_mfu if train_mfu is None else round(train_mfu, 4)})")
+
+        def section(name, fn, retries=1):
+            """Sections after the headline are best-effort: a failure (OOM on a
+            small chip, missing native lib, …) records an error string instead of
+            losing the whole BENCH record. One retry after a pause: transient
+            tunnel drops (r03: `remote_compile: response body closed` cost the
+            whole batch-scaling table) usually clear within a minute. The sampler
+            timings (`timed`) and scaling rows (`scaling_rows`) are memoized so a
+            retry mostly redoes the failed tail; e2e never retries — a second
+            "cold" epoch runs against warm caches and would overstate the cold
+            number. A deterministic failure (OOM) costs one useless pause."""
+            for attempt in range(1 + max(0, retries)):
+                if attempt:
+                    for _ in range(12):  # 60s total, in marked chunks — one
+                        mark(f"{name} retry backoff")  # long silent sleep
+                        time.sleep(5.0)  # would trip a short stall deadline
+                try:
+                    fn()
+                    sub.pop(name + "_error", None)  # clean record if retry healed
+                    return
+                except Exception as e:  # noqa: BLE001 — deliberate catch-all
+                    log(f"{name} section failed (attempt {attempt + 1}): "
+                        f"{type(e).__name__}: {e}")
+                    sub[name + "_error"] = f"{type(e).__name__}: {e}"
+
+        # --------------------------------------------------------- batch scaling
+        scaling_rows = {}  # per-batch memo: a section retry redoes only the tail
+
+        def run_scaling():
+            for b in (64, 128, 256):
+                if b in scaling_rows:
+                    continue
+                bt = synth_batch(b)
+                st = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
+                                        total_steps=51200, sample_batch=bt)
+                st, sp, _ = time_train(st, bt, max(10, args.steps // 2))
+                fl = flops_util.train_step_flops(b, mlp_ratio=1.0,
+                                                 **MODEL_CONFIGS["vit_tiny"])
+                m = flops_util.mfu(fl, sp, chip)
+                scaling_rows[b] = {"batch": b, "ms_per_step": round(1000 * sp, 3),
+                                   "img_per_sec": round(b / sp, 1),
+                                   "mfu": None if m is None else round(m, 4)}
+                log(f"scaling b{b}: {1000*sp:.2f} ms/step ({b/sp:.0f} img/s, "
+                    f"mfu={scaling_rows[b]['mfu']})")
+                # write-through per row: measured rows survive in the record
+                # even if a later batch OOMs on both attempts
+                sub["batch_scaling"] = [
+                    scaling_rows[x] for x in sorted(scaling_rows)]
+
+        if not args.skip_scaling:
+            section("batch_scaling", run_scaling)
+
+        # ----------------------------------------------------------- scan_blocks
+        def run_scan_blocks():
+            # measured basis for the PERF.md compile-vs-step decision: the same
+            # headline step with depth under nn.scan (stacked params, one
+            # compiled block body) vs the unrolled headline above
+            sc_model = DiffusionViT(dtype=jnp.bfloat16, scan_blocks=True,
+                                    **MODEL_CONFIGS["vit_tiny"])
+            st = create_train_state(sc_model, jax.random.PRNGKey(0), lr=2e-4,
+                                    total_steps=51200, sample_batch=batch)
+            _, sp, comp = time_train(st, batch, max(10, args.steps // 2),
+                                     step=make_train_step(sc_model))
+            sub["scan_blocks"] = {
+                "batch": B,
+                "ms_per_step": round(1000 * sp, 3),
+                "img_per_sec": round(B / sp, 1),
+                "compile_s": round(comp, 1),
+                "unrolled_ms_per_step": round(1000 * spi, 3),
+                "unrolled_compile_s": round(compile_s, 1)}
+            log(f"scan_blocks b{B}: {1000*sp:.2f} ms/step (compile {comp:.1f}s) "
+                f"vs unrolled {1000*spi:.2f} ms/step (compile {compile_s:.1f}s)")
+
+        if not args.skip_scaling:  # --skip-scaling drops both depth-layout rows
+            section("scan_blocks", run_scan_blocks)
+
+        # ------------------------------------------------------------- samplers
+        def time_ddim(smodel, sparams, k, n, label):
+            """Compile+sync one sampling run, then time TWO and keep the faster
+            (one transient tunnel stall must not poison the record) — syncing via
+            a real host transfer (see time_train). Memoized per (model, k, n)."""
+            from ddim_cold_tpu.ops import sampling
+
+            # flax modules hash/compare by field values: same-config models
+            # share a memo row across sections, and a GC'd model's reused id()
+            # can never alias a different config onto a stale timing
+            key = (smodel, k, n)
+            if key not in timed:
+                mark(f"sampler compile {label} k={k} n={n}")
+                img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(2), k=k, n=n)
+                np.asarray(img)
+                best = float("inf")
+                for seed in (3, 4):
+                    mark(f"sampler timing {label} k={k} n={n}")
+                    t0 = time.time()
+                    img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(seed), k=k, n=n)
+                    np.asarray(img)
+                    best = min(best, time.time() - t0)
+                timed[key] = best
+            sdt = timed[key]
+            log(f"{label} DDIM k={k:3d} N={n}: {sdt:6.2f}s → {n/sdt:8.2f} img/s/chip")
+            return sdt
+
+        timed = {}
+        n_sample = 8 if args.smoke else 64
+
+        def run_sampler64():
+            k20 = time_ddim(model, state.params, 20, n_sample, "vit_tiny 64px")
+            sub["sampler_throughput_64px_k20"] = {
+                "value": round(n_sample / k20, 2), "unit": "img/s/chip"}
+
+        if not args.skip_sampler:
+            section("sampler_64px", run_sampler64)
+
+        def run_ksweep():
+            sweep = {}
+            for k in (5, 20, 50) if args.smoke else (1, 5, 20, 50):
+                sweep[str(k)] = round(
+                    n_sample / time_ddim(model, state.params, k, n_sample, "k-sweep"), 2)
+            sub["ksweep_64px_img_per_sec"] = sweep
+
+        if args.ksweep:
+            section("ksweep", run_ksweep)
+
+        def run_northstar():
+            # the acceptance metric: 200px DDIM k=20 img/s/chip (BASELINE.json)
+            n, k = 16, 20
+            ns_params = None
+            flash_model = None
+            for flash in (False, True):
+                ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
+                                        **MODEL_CONFIGS["oxford_flower_200_p4"])
+                if flash:
+                    flash_model = ns_model
+                if ns_params is None:
+                    mark("north-star 200px param init")
+                    ns_params = ns_model.init(
+                        jax.random.PRNGKey(0),
+                        jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
+                sdt = time_ddim(ns_model, ns_params, k, n,
+                                f"north-star 200px flash={int(flash)}")
+                sub["sampler_throughput_200px_k20" + ("_flash" if flash else "_dense")] = {
+                    "value": round(n / sdt, 2), "unit": "img/s/chip", "n": n, "k": k}
+            # headline north-star alias = the faster of the two attention paths
+            best = max(sub["sampler_throughput_200px_k20_flash"]["value"],
+                       sub["sampler_throughput_200px_k20_dense"]["value"])
+            sub["sampler_throughput_200px_k20"] = {
+                "value": best, "unit": "img/s/chip", "n": n, "k": k}
+            # best-achievable leg (separate submetric — the headline above stays
+            # pinned to the n=16 definition BASELINE.json publishes): flash never
+            # materializes the N² attention matrix (dense at N=2501 burns
+            # ~100 MB/img/layer on the f32 softmax, which is what pins the paired
+            # comparison at n=16), so the flash path can batch 4× higher — the
+            # throughput a user actually gets. Best-effort: a failure here (e.g.
+            # RESOURCE_EXHAUSTED on a smaller-HBM chip) must not flag the
+            # already-captured n=16 headline as a failed section.
+            n_big = 64
+            try:
+                sdt = time_ddim(flash_model, ns_params, k, n_big,
+                                f"north-star 200px flash n={n_big}")
+                sub["sampler_throughput_200px_k20_flash_n64"] = {
+                    "value": round(n_big / sdt, 2), "unit": "img/s/chip",
+                    "n": n_big, "k": k}
+            except Exception as e:  # noqa: BLE001 — recorded, never fatal
+                sub["northstar_n64_error"] = f"{type(e).__name__}: {e}"[:300]
+
+        if not args.skip_northstar:
+            section("northstar", run_northstar)
+
+        # ------------------------------------------------- e2e with the data path
+        if not args.skip_e2e:
+            # retries=0: a re-run's "cold" epoch would hit warm jit/page caches
+            section("e2e", lambda: sub.update(_bench_e2e(args, model, state, log)),
+                    retries=0)
+
+        print(json.dumps(record))
+    except Exception as e:  # noqa: BLE001 — emit-then-reraise, not swallow
+        # a fatal error outside any section (e.g. headline OOM) must not cost
+        # the whole record: the metadata + whatever sections finished are out
+        # before the nonzero exit, same contract as the stall watchdog
+        sub["fatal_error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(record))
+        sys.stdout.flush()
+        raise
+    finally:
+        progress["done"] = True
 
 
 def _bench_e2e(args, model, state, log):
@@ -351,6 +487,8 @@ def _bench_e2e(args, model, state, log):
         mk = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mk)
         tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+        _E2E_TMP["path"] = tmp
+        log(f"e2e: generating {n_imgs}-image temp dataset")  # liveness beacon
         mk.write_split(tmp, "train", n_imgs, 64, 20220822)
         root = os.path.join(tmp, "train")
     try:
@@ -379,6 +517,7 @@ def _bench_e2e(args, model, state, log):
         import numpy as _np
 
         _r = _np.random.RandomState(7)
+        log("e2e: warmup compile")  # liveness beacon before the silent compile
         if getattr(ds, "_uniform_u8", False):
             bases = _np.asarray(
                 _r.randint(0, 256, size=(args.batch, 64, 64, 3)), _np.uint8)
@@ -391,6 +530,7 @@ def _bench_e2e(args, model, state, log):
              jnp.asarray(_r.randint(1, 7, size=(args.batch,)), jnp.int32)),
             jax.random.PRNGKey(0), jnp.float32(5.0))
         for label in ("cold", "warm"):
+            log(f"e2e: {label} epoch start")  # liveness beacon
             loader.set_epoch(0)
             ema = jnp.float32(5.0)
             t0, nb = time.time(), 0
@@ -411,6 +551,7 @@ def _bench_e2e(args, model, state, log):
     finally:
         if tmp:
             shutil.rmtree(tmp, ignore_errors=True)
+            _E2E_TMP["path"] = None
 
 
 if __name__ == "__main__":

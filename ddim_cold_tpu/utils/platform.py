@@ -44,6 +44,26 @@ _PROBE_CODE = (
 _PROBE_TTL_S = 600.0
 
 
+def effective_platforms() -> str:
+    """The platform list JAX will actually use, without touching the
+    backend: jax.config (where site hooks and :func:`honor_env_platform`
+    write) wins over the pre-import env var. Empty string when nothing is
+    configured (JAX will then auto-detect). The ONE owner of this resolution
+    rule — :func:`ensure_live_backend` and bench.py's stall watchdog both
+    derive from it so the probe decision and the watchdog arming can never
+    drift apart."""
+    import jax
+
+    return (jax.config.jax_platforms or "").strip() or os.environ.get(
+        "JAX_PLATFORMS", "").strip()
+
+
+def effective_first_platform() -> str:
+    """First entry of :func:`effective_platforms` (the backend JAX tries
+    first); empty string when nothing is configured."""
+    return effective_platforms().split(",")[0].strip()
+
+
 def probe_marker_path(first: str) -> str:
     """Per-user probe-success marker for platform ``first`` — shared by
     :func:`ensure_live_backend` and the recovery watcher
@@ -85,9 +105,8 @@ def ensure_live_backend(timeout_s: float = 120.0, *, attempts: int = 1,
 
     # the parent's FIRST device query resolves from jax.config (site hooks
     # and honor_env_platform write there); env is only the pre-import intent
-    effective = (jax.config.jax_platforms or "").strip() or os.environ.get(
-        "JAX_PLATFORMS", "").strip()
-    first = effective.split(",")[0].strip()
+    effective = effective_platforms()
+    first = effective_first_platform()
     if first == "cpu":
         return "default", "already cpu-pinned"
 

@@ -20,3 +20,52 @@ def test_bench_smoke_record(capsys):
     assert rec["chip"] == "cpu"
     assert "submetrics" in rec and isinstance(rec["submetrics"], dict)
     assert np.isfinite(rec["ms_per_step"]) and rec["ms_per_step"] > 0
+
+
+def test_bench_stall_watchdog_emits_partial_record():
+    """A wedged RPC mid-run (tunnel drop: the call blocks forever, no
+    exception) must still produce a parseable record: the watchdog emits the
+    partial JSON and exits (nonzero, so callers never log the partial run
+    as success) instead of hanging until an outer kill — which
+    would both lose the round's BENCH record and wedge the tunnel for the
+    next client (utils/platform.py)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(DDIM_COLD_BENCH_STALL_S="2", DDIM_COLD_BENCH_TEST_HANG_S="3600",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--cpu", "--steps", "2",
+         "--batch", "2", "--skip-sampler"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-2000:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "aborted" in rec["submetrics"], rec
+    # the stall hit before the headline ran; the record says so honestly
+    assert rec["value"] is None
+    assert rec["metric"] == "train_throughput_vit_tiny64_b32"
+
+
+def test_bench_fatal_error_still_emits_partial_record():
+    """An exception escaping the try body (here: a headline failure forced by
+    an invalid batch) must emit the partial record with a fatal_error note
+    and exit nonzero — never crash recordless."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--cpu", "--steps", "2",
+         "--batch", "-1", "--skip-sampler"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode != 0
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "fatal_error" in rec["submetrics"], rec
+    assert rec["metric"] == "train_throughput_vit_tiny64_b32"
