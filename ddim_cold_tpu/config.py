@@ -88,6 +88,11 @@ class ExperimentConfig:
     # every N epochs, additionally save params to <run>/snapshots/epoch_<E>/ —
     # feeds the per-checkpoint FID trend (scripts/fid_trend.py); 0 = off
     snapshot_epochs: int = 0
+    # EMA shadow of the params (standard diffusion practice, absent upstream):
+    # 0 = off (default, byte-identical to the reference behavior); e.g. 0.999
+    # maintains ema ← d·ema + (1−d)·p each step, checkpointed alongside the
+    # live params (bestloss_ema.ckpt + ema_params in lastepoch.ckpt)
+    ema_decay: float = 0.0
 
     @property
     def effective_batch(self) -> int:
@@ -142,6 +147,14 @@ def _check_sp_mode(value: str) -> str:
     return value
 
 
+def _check_ema_decay(value: float) -> float:
+    # d=1.0 freezes the shadow at init forever; d>1 diverges to NaN within
+    # steps and the damage only surfaces at sampling time — fail loudly here
+    if not 0.0 <= value < 1.0:
+        raise ValueError(f"ema_decay must be in [0, 1), got {value!r}")
+    return value
+
+
 def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentConfig:
     """Parse a reference-schema YAML into an ExperimentConfig."""
     with open(yaml_path) as f:
@@ -181,4 +194,5 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         scan_blocks=bool(raw.get("scan_blocks", False)),
         microbatches=(int(raw["microbatches"]) if "microbatches" in raw else None),
         snapshot_epochs=int(raw.get("snapshot_epochs", 0)),
+        ema_decay=_check_ema_decay(float(raw.get("ema_decay", 0.0))),
     )

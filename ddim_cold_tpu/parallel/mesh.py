@@ -109,4 +109,9 @@ def shard_train_state(state, mesh: Mesh, specs=None):
         return jax.device_put(np.asarray(value), sharding)
 
     opt_state = jax.tree.map(place, state.opt_state, layout)
-    return state.replace(params=params, opt_state=opt_state)
+    extra = {}
+    if getattr(state, "ema_params", None) is not None:
+        # the EMA shadow mirrors the params' tree and must mirror their
+        # sharding too (elementwise update: no resharding in the step)
+        extra["ema_params"] = shard_params(state.ema_params, mesh, specs)
+    return state.replace(params=params, opt_state=opt_state, **extra)

@@ -156,6 +156,29 @@ def _fully_addressable(tree) -> bool:
     )
 
 
+def _check_loaded_params(loaded, expected, src_path: str) -> None:
+    """Fail LOUDLY on a config-mismatched warm-start/resume source (e.g. a
+    stale pkl/ckpt from a different-sized run under the same name): orbax
+    returns the ON-DISK shapes when they differ from a numpy template
+    (measured), and silently replacing the tree would surface only as an
+    opaque jit shape error — fatal for unattended evidence runs."""
+    if jax.tree.structure(loaded) != jax.tree.structure(expected):
+        raise ValueError(
+            f"initializing file {src_path} does not match this model config "
+            "(different param tree — wrong depth, positional-embedding mode, "
+            "or bias layout)")
+    paths = jax.tree_util.tree_flatten_with_path(expected)[0]
+    mism = [
+        f"{jax.tree_util.keystr(p)}: file {np.shape(a)} vs model {np.shape(b)}"
+        for (p, b), a in zip(paths, jax.tree.leaves(loaded))
+        if np.shape(a) != np.shape(b)]
+    if mism:
+        raise ValueError(
+            f"initializing file {src_path} does not match this model config "
+            f"— {'; '.join(mism[:4])}"
+            + (f"; +{len(mism) - 4} more" if len(mism) > 4 else ""))
+
+
 def _build_dataset(config: ExperimentConfig, root: str):
     cache = config.cache_images
     if config.dataset == "cold":
@@ -292,6 +315,9 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                      drop_last=False, pad_final_batch=True,
                                      num_threads=1)))
     sample = shard_batch(sample, mesh)
+    # no ema_decay here: the EMA shadow is seeded AFTER warm-start/resume
+    # resolve the actual starting params (below) — a create-time seed would
+    # be a dead full-tree copy on every warm-started run
     state = create_train_state(
         model, rng, config.lr, train_batches * config.epoch[1], sample
     )
@@ -303,31 +329,59 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     if config.initializing not in ("", "none"):
         init_path = os.path.join(saved_dir, config.initializing)
         ckpt.recover_swap(init_path)  # owner-side heal of a crashed save swap
+        loaded = None
         if os.path.isfile(init_path):
-            state = state.replace(
-                params=ckpt.load_torch_pkl(init_path, config.patch_size))
+            loaded = ckpt.load_torch_pkl(init_path, config.patch_size)
         elif os.path.isdir(init_path):
-            state = state.replace(
-                params=ckpt.restore_checkpoint(init_path, state.params))
+            # orbax restore with a template returns the ON-DISK shapes when
+            # they differ (measured) — validated below like the pkl branch
+            loaded = ckpt.restore_checkpoint(init_path, state.params)
         elif jax.process_index() == 0:
             try:
                 ckpt.save_torch_pkl(state.params, init_path, config.patch_size)
             except ImportError:
                 ckpt.save_checkpoint(init_path, state.params)
+        if loaded is not None:
+            _check_loaded_params(loaded, state.params, init_path)
+            state = state.replace(params=loaded)
 
     if config.resume != "none":
         ckpt.recover_swap(config.resume)  # owner-side heal (crashed save swap)
-        restored = ckpt.restore_checkpoint(
-            config.resume,
-            {"epoch": 0, "steps": 0, "loss_rec": 0.0, "metric": 0.0,
-             "params": state.params, "opt_state": state.opt_state},
-        )
+        base_tpl = {"epoch": 0, "steps": 0, "loss_rec": 0.0, "metric": 0.0,
+                    "params": state.params, "opt_state": state.opt_state}
+        want_ema = bool(config.ema_decay)
+        template = dict(base_tpl,
+                        **({"ema_params": state.params} if want_ema else {}))
+        try:
+            restored = ckpt.restore_checkpoint(config.resume, template)
+        except ValueError as first_err:
+            # orbax is strict BOTH ways about the optional ema_params key
+            # (measured: template-extra and template-missing each raise
+            # ValueError) — so ema_decay can be toggled across a resume:
+            # retry with the key flipped; if that fails too the mismatch was
+            # something else, so surface the ORIGINAL error, not the
+            # doubly-mutated retry's
+            alt = (dict(base_tpl) if want_ema
+                   else dict(base_tpl, ema_params=state.params))
+            try:
+                restored = ckpt.restore_checkpoint(config.resume, alt)
+            except Exception:
+                raise first_err
+            if want_ema:
+                print_log("resume checkpoint has no ema_params — re-seeding "
+                          "the EMA shadow from the restored params", log)
+            else:
+                print_log("resume checkpoint carries ema_params but "
+                          "ema_decay is off — dropping the shadow", log)
+        _check_loaded_params(restored["params"], state.params, config.resume)
         epoch_start = int(restored["epoch"]) + 1
         steps = int(restored["steps"])
         loss_rec = float(restored["loss_rec"])
         best_loss = float(restored["metric"])
         state = state.replace(
-            params=restored["params"], opt_state=restored["opt_state"], step=steps
+            params=restored["params"], opt_state=restored["opt_state"], step=steps,
+            **({"ema_params": restored["ema_params"]}
+               if want_ema and "ema_params" in restored else {}),
         )
         print_log(f"resuming from epoch {epoch_start:8d} of " + config.resume, log)
         print_log(f"recovering best_loss {best_loss:4f}", log)
@@ -336,13 +390,23 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         print_log("TrainSet batchs:" + str(train_batches), log)
         print_log("TestSet batchs:" + str(test_batches), log)
 
+    if config.ema_decay and (config.resume == "none"
+                             or "ema_params" not in restored):
+        # seed the EMA shadow from whatever params the run actually starts
+        # with (fresh init, warm-start, or an ema-less resume). jnp.copy, not
+        # aliasing: params and ema_params are both donated into the first
+        # step, and aliased donated buffers are rejected.
+        state = state.replace(
+            ema_params=jax.tree.map(jnp.copy, state.params))
+
     # parallelism-dependent param layout: pipeline shards the stacked blocks
     # over 'pipe'; tensor parallelism shards Megatron column/row kernels over
     # 'model'; pure-dp stays replicated (gradient psum implicit in jit).
     specs, apply_fn = layout_for_mesh(model, mesh, state.params,
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
-    train_step = make_train_step(model, apply_fn, prepare=prepare)
+    train_step = make_train_step(model, apply_fn, prepare=prepare,
+                                 ema_decay=config.ema_decay)
     eval_step = make_eval_step(model, apply_fn, prepare=eval_prepare)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
@@ -431,11 +495,14 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             if saver.sync:
                 # synchronous saves finish before the next (donating) step
                 params_snap, opt_snap = state.params, state.opt_state
+                ema_snap = state.ema_params
             else:
                 # snapshot on device: the live buffers are donated to the next
                 # train_step, so the async saver must read from its own copy
                 params_snap = jax.tree.map(jnp.copy, state.params)
                 opt_snap = jax.tree.map(jnp.copy, state.opt_state)
+                ema_snap = (jax.tree.map(jnp.copy, state.ema_params)
+                            if state.ema_params is not None else None)
 
             # NaN-safe: a diverged epoch (vloss NaN) compares False and leaves
             # best_loss finite — min() would store NaN and poison resume
@@ -445,28 +512,46 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
 
             def save_epoch(epoch=epoch, steps=steps, loss_rec=loss_rec,
                            improved=improved, best=best_loss,
-                           params=params_snap, opt_state=opt_snap):
+                           params=params_snap, opt_state=opt_snap,
+                           ema=ema_snap):
                 if improved:
                     ckpt.save_checkpoint(os.path.join(run_dir, "bestloss.ckpt"), params)
+                    if ema is not None:
+                        # the smoothed weights diffusion users actually sample
+                        # from; saved beside (never instead of) the live best
+                        ckpt.save_checkpoint(
+                            os.path.join(run_dir, "bestloss_ema.ckpt"), ema)
                     if jax.process_index() == 0 and _fully_addressable(params):
                         try:
                             ckpt.save_torch_pkl(params,
                                                 os.path.join(run_dir, "bestloss.pkl"),
                                                 config.patch_size)
+                            if ema is not None:  # reference-bridge export of
+                                ckpt.save_torch_pkl(  # the smoothed weights
+                                    ema,
+                                    os.path.join(run_dir, "bestloss_ema.pkl"),
+                                    config.patch_size)
                         except ImportError:
                             pass
                 if config.snapshot_epochs and epoch % config.snapshot_epochs == 0:
                     # bare-params snapshot for the FID trend
-                    # (scripts/fid_trend.py); keyed by epoch, never rewritten
+                    # (scripts/fid_trend.py); keyed by epoch, never rewritten.
+                    # With EMA on, the smoothed weights land beside as
+                    # epoch_<E>_ema (the trend's strict epoch_(\d+) match
+                    # keeps its raw-params series uncontaminated).
                     snap_dir = os.path.join(run_dir, "snapshots")
                     os.makedirs(snap_dir, exist_ok=True)
                     ckpt.save_checkpoint(
                         os.path.join(snap_dir, f"epoch_{epoch}"), params)
+                    if ema is not None:
+                        ckpt.save_checkpoint(
+                            os.path.join(snap_dir, f"epoch_{epoch}_ema"), ema)
                 ckpt.save_checkpoint(
                     os.path.join(run_dir, "lastepoch.ckpt"),
                     {"epoch": epoch, "steps": steps, "loss_rec": loss_rec,
                      "metric": best, "params": params,
-                     "opt_state": opt_state},
+                     "opt_state": opt_state,
+                     **({"ema_params": ema} if ema is not None else {})},
                 )
 
             saver.submit(save_epoch)

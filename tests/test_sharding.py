@@ -32,13 +32,14 @@ def _fake_batch(n=8):
     )
 
 
-def _tiny_state(rng_seed=0):
+def _tiny_state(rng_seed=0, ema_decay=0.0):
     model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
                          num_heads=4, drop_rate=0.0, attn_drop_rate=0.0,
                          drop_path_rate=0.0)
     batch = tuple(jnp.asarray(b) for b in _fake_batch())
     state = create_train_state(model, jax.random.PRNGKey(rng_seed), lr=1e-3,
-                               total_steps=100, sample_batch=batch)
+                               total_steps=100, sample_batch=batch,
+                               ema_decay=ema_decay)
     return model, state, batch
 
 
@@ -176,3 +177,25 @@ def test_loader_mesh_composition(synthetic_image_dir):
     for step in range(2):
         merged = np.concatenate([per_host[0][step], per_host[1][step]])
         assert len(set(merged.tolist())) == 16
+
+
+def test_ema_shadow_cosharded_under_tp_mesh():
+    """ema_params mirrors the params' tensor shardings through
+    shard_train_state, and a tp×dp step updates the shadow to the same values
+    as an unsharded step (elementwise decay: no resharding inserted)."""
+    model, s1, batch = _tiny_state(ema_decay=0.9)
+    step = make_train_step(model, ema_decay=0.9)
+    rng = jax.random.PRNGKey(7)
+    s1, _, _ = step(s1, batch, rng, jnp.float32(5.0))
+
+    _, s2, _ = _tiny_state(ema_decay=0.9)
+    mesh = make_mesh({"data": 2, "model": 4})
+    specs = param_partition_specs(s2.params)
+    s2 = shard_train_state(s2, mesh, specs)
+    qkv = s2.ema_params["blocks_0"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == specs["blocks_0"]["attn"]["qkv"]["kernel"]
+    s2, _, _ = step(s2, shard_batch(batch, mesh), rng, jnp.float32(5.0))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5),
+        s1.ema_params, s2.ema_params)
