@@ -63,7 +63,8 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
 
 def make_train_step(model, apply_fn: Optional[Callable] = None,
                     prepare: Optional[Callable] = None,
-                    ema_decay: float = 0.0) -> Callable:
+                    ema_decay: float = 0.0,
+                    grad_accum: int = 1) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
@@ -83,8 +84,27 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
     bias is irrelevant over a full training run and the seed is the init
     params, not zeros). Elementwise, so it fuses into the optimizer tail and
     inherits whatever sharding the params carry.
+
+    ``grad_accum`` > 1 splits each step's batch into that many equal
+    micro-slices and runs them through one ``lax.scan``, averaging the
+    per-slice gradients before the single optimizer update — the standard
+    big-batch-on-small-HBM tool (absent upstream). Peak activation memory
+    drops ~grad_accum×; with dropout off the result is numerically
+    equivalent to the unaccumulated step (smooth-L1 is a mean, and the mean
+    of equal-sized slice means is the full-batch mean — only the float
+    summation order differs, ~1e-7); with dropout on each slice folds its
+    own mask key, which is the correct regularization, not a divergence.
+    Slices are INTERLEAVED (slice j = rows j, j+ga, …): under a
+    batch-dim-sharded mesh each slice stays evenly distributed over the
+    'data' axis, where a contiguous split would park whole slices on one
+    device and idle the rest.
     """
     apply_fn = apply_fn or model.apply
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if not 0.0 <= ema_decay < 1.0:  # same bound config.py enforces — direct
+        raise ValueError(  # API callers must not bypass it (1.0 freezes the
+            f"ema_decay must be in [0, 1), got {ema_decay!r}")  # shadow)
 
     @partial(jax.jit, donate_argnums=(0, 3))
     def train_step(state: EmaTrainState, batch, rng: jax.Array,
@@ -98,16 +118,48 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
         noisy, target, t = batch
         dropout_rng = jax.random.fold_in(rng, state.step)
 
-        def loss_fn(params):
+        def loss_fn(params, noisy, target, t, drop_rng):
             pred = apply_fn(
                 {"params": params}, noisy, t, deterministic=False,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": drop_rng},
             )
             return smooth_l1(pred, target)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, noisy, target, t, dropout_rng)
+        else:
+            b = noisy.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum {grad_accum}")
+            split = lambda x: x.reshape(  # noqa: E731 — interleaved: see doc
+                (b // grad_accum, grad_accum) + x.shape[1:]).swapaxes(0, 1)
+
+            def slice_grad(carry, sl):
+                mb_noisy, mb_target, mb_t, i = sl
+                loss_i, g_i = jax.value_and_grad(loss_fn)(
+                    state.params, mb_noisy, mb_target, mb_t,
+                    jax.random.fold_in(dropout_rng, i))
+                return (jax.tree.map(jnp.add, carry[0], g_i),
+                        carry[1] + loss_i), None
+
+            zero = (jax.tree.map(jnp.zeros_like, state.params),
+                    jnp.float32(0.0))
+            (gsum, lsum), _ = jax.lax.scan(
+                slice_grad, zero,
+                (split(noisy), split(target), split(t),
+                 jnp.arange(grad_accum)))
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            loss = lsum / grad_accum
         new_state = state.apply_gradients(grads=grads)
-        if ema_decay and state.ema_params is not None:
+        if ema_decay:
+            if state.ema_params is None:  # trace-time: silently training
+                raise ValueError(  # with no shadow would surface only when
+                    # bestloss_ema is missing at the end of the run
+                    "ema_decay > 0 but the state carries no ema_params — "
+                    "create it with create_train_state(..., ema_decay=...) "
+                    "or seed state.replace(ema_params=...)")
             new_state = new_state.replace(ema_params=optax.incremental_update(
                 new_state.params, state.ema_params,
                 step_size=1.0 - ema_decay))

@@ -269,6 +269,16 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
         raise ValueError(
             f"pipeline needs global batch {global_batch} divisible by "
             f"microbatches {n_micro} and each microbatch by data={data_mesh_size}")
+    if config.grad_accum > 1:
+        if pipe_stages > 1:
+            raise ValueError(
+                "grad_accum composes with dp/tp/sp only — the pipe axis has "
+                "its own microbatching (config.microbatches)")
+        if (global_batch % config.grad_accum
+                or (global_batch // config.grad_accum) % data_mesh_size):
+            raise ValueError(
+                f"grad_accum needs global batch {global_batch} divisible by "
+                f"{config.grad_accum} and each slice by data={data_mesh_size}")
     shard_index, shard_count = jax.process_index(), jax.process_count()
     train_set = _build_dataset(config, config.data_storage[0])
     test_set = _build_dataset(config, config.data_storage[1])
@@ -406,7 +416,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
     train_step = make_train_step(model, apply_fn, prepare=prepare,
-                                 ema_decay=config.ema_decay)
+                                 ema_decay=config.ema_decay,
+                                 grad_accum=config.grad_accum)
     eval_step = make_eval_step(model, apply_fn, prepare=eval_prepare)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)

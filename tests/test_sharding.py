@@ -199,3 +199,23 @@ def test_ema_shadow_cosharded_under_tp_mesh():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5),
         s1.ema_params, s2.ema_params)
+
+
+def test_grad_accum_under_dp_mesh_matches():
+    """grad_accum under a data-sharded mesh reproduces the single-device
+    accumulated step — the interleaved slice layout keeps every micro-slice
+    resident across the 'data' axis (a contiguous split would reshard or
+    idle devices each scan iteration)."""
+    model, s1, batch = _tiny_state()
+    step = make_train_step(model, grad_accum=2)
+    rng = jax.random.PRNGKey(7)
+    s1, _, _ = step(s1, batch, rng, jnp.float32(5.0))
+
+    _, s2, _ = _tiny_state()
+    mesh = make_mesh({"data": 8})
+    s2 = shard_train_state(s2, mesh, None)
+    s2, _, _ = step(s2, shard_batch(batch, mesh), rng, jnp.float32(5.0))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5),
+        s1.params, s2.params)
