@@ -162,10 +162,23 @@ def _dig(tree, keys):
 
 def load_torch_pkl(path: str, patch_size: int) -> dict:
     """Load a reference ``*.pkl`` (bare state_dict or the lastepoch dict) into
-    a Flax param tree. Requires torch at conversion time only."""
-    import torch
+    a Flax param tree. Uses torch when importable; otherwise falls back to the
+    torch-free zip-format reader (:mod:`.torch_pickle`) — a TPU host needs no
+    torch install to ingest reference checkpoints (parity pinned by
+    tests/test_torch_pickle.py::test_load_torch_pkl_falls_back_without_torch).
+    """
+    try:
+        # only the IMPORT selects the fallback: an ImportError raised inside
+        # torch.load itself (e.g. a module named by the pickle stream missing
+        # on this host) is a real error that must surface, not trigger a
+        # silent re-parse that fails elsewhere
+        import torch
+    except ImportError:
+        from ddim_cold_tpu.utils import torch_pickle
 
-    obj = torch.load(path, map_location="cpu", weights_only=False)
+        obj = torch_pickle.load(path)
+    else:
+        obj = torch.load(path, map_location="cpu", weights_only=False)
     if isinstance(obj, dict) and "state_dict" in obj:
         obj = obj["state_dict"]
     return flax_from_torch_state_dict(obj, patch_size)

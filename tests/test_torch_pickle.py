@@ -1,0 +1,156 @@
+"""Torch-free reader of torch zip checkpoints (utils/torch_pickle.py) —
+parity against real ``torch.load``/``torch.save`` output (SURVEY.md §7 hard
+part: conversion without torch installed)."""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from ddim_cold_tpu.utils import torch_pickle  # noqa: E402
+
+
+def test_reads_plain_tensor_dict(tmp_path):
+    """dtypes, shapes, non-contiguous tensors, 0-dim tensors, nesting."""
+    t = {
+        "f32": torch.arange(6, dtype=torch.float32).reshape(2, 3),
+        "noncontig": torch.arange(6, dtype=torch.float32).reshape(2, 3).t(),
+        "f16": torch.full((3,), 1.5, dtype=torch.float16),
+        "bf16": torch.full((4,), 0.25, dtype=torch.bfloat16),
+        "i64": torch.arange(4),
+        "u8": torch.arange(5, dtype=torch.uint8),
+        "scalar": torch.tensor(7.0),
+        "nested": {"x": torch.ones(2, 2)},
+        "plain": 3,
+        "lst": [torch.zeros(1), "s"],
+    }
+    p = str(tmp_path / "t.pkl")
+    torch.save(t, p)
+    got = torch_pickle.load(p)
+    assert got["plain"] == 3 and got["lst"][1] == "s"
+    for key, want in [("f32", t["f32"]), ("noncontig", t["noncontig"]),
+                      ("f16", t["f16"]), ("i64", t["i64"]), ("u8", t["u8"]),
+                      ("scalar", t["scalar"]), ("nested", t["nested"]["x"])]:
+        g = got["nested"]["x"] if key == "nested" else got[key]
+        np.testing.assert_array_equal(np.asarray(g, dtype=np.float64)
+                                      if g.dtype != np.uint8 else g,
+                                      want.numpy().astype(np.float64)
+                                      if key != "u8" else want.numpy())
+    assert got["bf16"].dtype.name == "bfloat16"
+    np.testing.assert_array_equal(got["bf16"].astype(np.float32),
+                                  t["bf16"].float().numpy())
+
+
+def test_model_state_dict_parity_with_torch_load(tmp_path):
+    """A real model state_dict round-trips identically through the torch-free
+    reader and torch.load → the exact Flax tree either way."""
+    from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    import jax
+
+    model = DiffusionViT(**MODEL_CONFIGS["vit_tiny"])
+    params = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, 64, 64, 3), np.float32), np.zeros((1,), np.int32)
+    )["params"]
+    p = str(tmp_path / "best.pkl")
+    ckpt.save_torch_pkl(params, p, patch_size=8)
+
+    via_torch = torch.load(p, map_location="cpu", weights_only=False)
+    via_native = torch_pickle.load(p)
+    assert set(via_native) == set(via_torch)
+    for k in via_torch:
+        np.testing.assert_array_equal(np.asarray(via_native[k]),
+                                      via_torch[k].numpy())
+
+    a = ckpt.flax_from_torch_state_dict(via_native, patch_size=8)
+    b = ckpt.flax_from_torch_state_dict(
+        {k: v.numpy() for k, v in via_torch.items()}, patch_size=8)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)), a, b)
+
+
+def test_lastepoch_style_dict(tmp_path):
+    """The reference's lastepoch layout: nested dict with non-tensor leaves
+    and a DDP-prefixed state_dict (multi_gpu_trainer.py:155-163)."""
+    sd = {"module.head.weight": torch.randn(4, 8),
+          "module.head.bias": torch.zeros(4)}
+    obj = {"epoch": 3, "steps": 1536, "loss_rec": 0.123, "metric": 0.05,
+           "state_dict": sd}
+    p = str(tmp_path / "last.pkl")
+    torch.save(obj, p)
+    got = torch_pickle.load(p)
+    assert got["epoch"] == 3 and got["steps"] == 1536
+    np.testing.assert_allclose(got["state_dict"]["module.head.weight"],
+                               sd["module.head.weight"].numpy())
+
+
+def test_load_torch_pkl_falls_back_without_torch(tmp_path, monkeypatch):
+    """checkpoint.load_torch_pkl produces the same Flax tree when torch is
+    unimportable (simulated) as when it is present."""
+    import builtins
+
+    from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    import jax
+
+    model = DiffusionViT(**MODEL_CONFIGS["vit_tiny"])
+    params = model.init(
+        jax.random.PRNGKey(1),
+        np.zeros((1, 64, 64, 3), np.float32), np.zeros((1,), np.int32)
+    )["params"]
+    p = str(tmp_path / "best.pkl")
+    ckpt.save_torch_pkl(params, p, patch_size=8)
+
+    with_torch = ckpt.load_torch_pkl(p, patch_size=8)
+
+    real_import = builtins.__import__
+
+    def no_torch(name, *args, **kwargs):
+        if name == "torch" or name.startswith("torch."):
+            raise ImportError("torch disabled for this test")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_torch)
+    without_torch = ckpt.load_torch_pkl(p, patch_size=8)
+    monkeypatch.undo()
+
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        with_torch, without_torch)
+
+
+def test_non_zip_file_raises_clearly(tmp_path):
+    p = str(tmp_path / "legacy.pkl")
+    with open(p, "wb") as f:
+        f.write(b"\x80\x02not a zip")
+    with pytest.raises(Exception, match="[Zz]ip|torch"):
+        torch_pickle.load(p)
+
+
+def test_rejects_non_checkpoint_globals(tmp_path):
+    """A pickle that reaches for a non-torch global (the classic os.system
+    reduce) is refused instead of executed — pickle's default find_class
+    would import and invoke it."""
+    import io
+    import pickle
+    import zipfile
+
+    class Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    buf = io.BytesIO()
+    pickle.dump({"x": Evil()}, buf)
+    p = str(tmp_path / "evil.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(pickle.UnpicklingError, match="refusing"):
+        torch_pickle.load(p)
