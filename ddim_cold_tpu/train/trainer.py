@@ -347,10 +347,9 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             # they differ (measured) — validated below like the pkl branch
             loaded = ckpt.restore_checkpoint(init_path, state.params)
         elif jax.process_index() == 0:
-            try:
-                ckpt.save_torch_pkl(state.params, init_path, config.patch_size)
-            except ImportError:
-                ckpt.save_checkpoint(init_path, state.params)
+            # torch-less hosts still write the pkl (checkpoint.save_torch_pkl
+            # falls back to the native zip-format writer internally)
+            ckpt.save_torch_pkl(state.params, init_path, config.patch_size)
         if loaded is not None:
             _check_loaded_params(loaded, state.params, init_path)
             state = state.replace(params=loaded)
@@ -533,6 +532,10 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                         ckpt.save_checkpoint(
                             os.path.join(run_dir, "bestloss_ema.ckpt"), ema)
                     if jax.process_index() == 0 and _fully_addressable(params):
+                        # best-effort bridge export (torch-less hosts fall
+                        # back to the native writer internally): a refused
+                        # export must never kill the run at its best-loss
+                        # moment — the orbax ckpt above is already safe
                         try:
                             ckpt.save_torch_pkl(params,
                                                 os.path.join(run_dir, "bestloss.pkl"),
@@ -542,8 +545,8 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                     ema,
                                     os.path.join(run_dir, "bestloss_ema.pkl"),
                                     config.patch_size)
-                        except ImportError:
-                            pass
+                        except Exception as e:  # noqa: BLE001
+                            print_log(f"bestloss pkl export skipped: {e}", log)
                 if config.snapshot_epochs and epoch % config.snapshot_epochs == 0:
                     # bare-params snapshot for the FID trend
                     # (scripts/fid_trend.py); keyed by epoch, never rewritten.

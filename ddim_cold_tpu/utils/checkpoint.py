@@ -185,12 +185,20 @@ def load_torch_pkl(path: str, patch_size: int) -> dict:
 
 
 def save_torch_pkl(params, path: str, patch_size: int) -> None:
-    """Write params as a torch state_dict pickle a reference user can load."""
-    import torch
+    """Write params as a torch state_dict pickle a reference user can load.
+    Torch-less hosts fall back to the native zip-format writer
+    (:func:`.torch_pickle.save`) — real ``torch.load`` reads its output
+    (parity pinned by tests/test_torch_pickle.py)."""
+    sd_np = {k: np.array(v, order="C")
+             for k, v in torch_state_dict_from_flax(params, patch_size).items()}
+    try:
+        import torch
+    except ImportError:
+        from ddim_cold_tpu.utils import torch_pickle
 
-    sd = {k: torch.from_numpy(np.array(v, order="C"))
-          for k, v in torch_state_dict_from_flax(params, patch_size).items()}
-    torch.save(sd, path)
+        torch_pickle.save(sd_np, path)
+        return
+    torch.save({k: torch.from_numpy(v) for k, v in sd_np.items()}, path)
 
 
 # ---------------------------------------------------------------------------

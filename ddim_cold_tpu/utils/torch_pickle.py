@@ -32,6 +32,27 @@ from typing import Any
 
 import numpy as np
 
+#: largest tensor this reader will materialize (it copies, unlike
+#: torch.load's cheap views) — far above any in-scope checkpoint, far below
+#: a crafted 0-stride/huge-size allocation bomb
+_MAX_TENSOR_BYTES = 2 << 30
+
+def _check_materialization_cap(shape, itemsize: int, exc=None) -> tuple:
+    """Normalize ``shape`` to a dims tuple and enforce the byte cap — the
+    ONE owner of the policy shared by all three enforcement points
+    (:func:`_rebuild_tensor_v2`, :class:`_BoundedNdarray`,
+    ``_checked_reconstruct``), so they cannot drift."""
+    import math
+
+    dims = ((int(shape),) if isinstance(shape, (int, np.integer))
+            else tuple(int(d) for d in shape))
+    if math.prod(dims or (1,)) * max(1, int(itemsize)) > _MAX_TENSOR_BYTES:
+        raise (exc or pickle.UnpicklingError)(
+            f"array of shape {dims} (itemsize {itemsize}) exceeds the "
+            f"{_MAX_TENSOR_BYTES}-byte materialization cap — load with torch")
+    return dims
+
+
 #: torch storage-class name → numpy dtype (the classes themselves are
 #: pickled BY NAME, so no torch import is needed to resolve them)
 _STORAGE_DTYPES = {
@@ -64,10 +85,14 @@ class _NamedStub:
         return f"<torch-stub {self.module}.{self.name}>"
 
 
-def _np_dtype(storage_name: str):
+def _np_dtype(storage_name: str) -> np.dtype:
     if storage_name not in _STORAGE_DTYPES:
         raise ValueError(f"unsupported torch storage type {storage_name!r}")
     dt = _STORAGE_DTYPES[storage_name]
+    if dt is None:  # UntypedStorage: numel is in BYTES and the dtype lives
+        raise ValueError(  # in tensor metadata this reader doesn't consume
+            "untyped torch storage needs the dtype from tensor metadata "
+            "— not produced by reference-era torch.save; load with torch")
     if dt == "bfloat16":
         import ml_dtypes  # jax dependency, present wherever this repo runs
 
@@ -77,16 +102,88 @@ def _np_dtype(storage_name: str):
 
 def _rebuild_tensor_v2(storage, offset, size, stride, *unused) -> np.ndarray:
     """numpy re-implementation of ``torch._utils._rebuild_tensor_v2``:
-    a strided view into the storage buffer (torch strides are in ELEMENTS)."""
+    a strided view into the storage buffer (torch strides are in ELEMENTS).
+
+    size/stride/offset come from the pickle stream INDEPENDENTLY of the
+    storage length, so they are validated against it before ``as_strided``
+    — unchecked they would address arbitrary process memory (the tensor-path
+    analogue of the find_class hardening below)."""
     buf, dtype = storage
     itemsize = dtype.itemsize
+    size, stride = tuple(size), tuple(stride)
+    if offset < 0 or any(d < 0 for d in size) or any(s < 0 for s in stride):
+        raise ValueError(
+            f"corrupt tensor metadata: offset={offset} size={size} "
+            f"stride={stride}")
     if not size:  # 0-dim tensor
+        if (offset + 1) * itemsize > len(buf):
+            raise ValueError("corrupt tensor metadata: offset past storage")
         return np.frombuffer(buf, dtype=dtype, count=1, offset=offset * itemsize
                              ).reshape(()).copy()
+    if 0 in size:
+        return np.zeros(size, dtype=dtype)
+    # this reader MATERIALIZES tensors, so 0-stride expand() metadata (a
+    # cheap view under torch.load) or a crafted size could demand an
+    # unbounded allocation from a tiny storage
+    _check_materialization_cap(size, itemsize, exc=ValueError)
     flat = np.frombuffer(buf, dtype=dtype, offset=offset * itemsize)
+    span = sum((d - 1) * s for d, s in zip(size, stride)) + 1
+    if span > flat.size:
+        raise ValueError(
+            f"corrupt tensor metadata: size={size} stride={stride} span "
+            f"{span} elements exceeds storage of {flat.size}")
     arr = np.lib.stride_tricks.as_strided(
-        flat, shape=tuple(size), strides=tuple(s * itemsize for s in stride))
-    return np.ascontiguousarray(arr)  # own the memory; drop the view
+        flat, shape=size, strides=tuple(s * itemsize for s in stride))
+    # UNCONDITIONAL copy (ascontiguousarray would no-op on an already-
+    # contiguous view): the view over frombuffer(bytes) is read-only and
+    # pins the whole storage buffer alive
+    return np.array(arr)
+
+
+#: the numpy reconstruction globals a checkpoint's METADATA may legitimately
+#: reference (numpy-typed scalars/arrays in e.g. a lastepoch dict) — mirrors
+#: torch's own weights_only allowlist; anything else stays refused
+_NUMPY_ALLOWLIST = frozenset(
+    (mod, name)
+    for mod in ("numpy._core.multiarray", "numpy.core.multiarray")
+    for name in ("scalar", "_reconstruct")
+) | frozenset((("numpy", "dtype"), ("numpy", "ndarray"),
+               ("_codecs", "encode"),  # numpy scalar payloads pickle via it
+               # protocol 2 pickles EMPTY bytes as the bytes global itself
+               # (non-empty go via _codecs.encode); the constructor of a
+               # primitive is safe to resolve
+               ("__builtin__", "bytes"), ("builtins", "bytes")))
+
+
+class _BoundedNdarray(np.ndarray):
+    """ndarray whose construction is capped at ``_MAX_TENSOR_BYTES`` —
+    handed out in place of the raw ``numpy.ndarray`` global so a crafted
+    pickle cannot request an unbounded uninitialized allocation. Legit
+    metadata arrays (built via numpy's ``_reconstruct`` + setstate, whose
+    payload is bounded by the file itself) work unchanged."""
+
+    def __new__(cls, shape=0, *args, **kwargs):
+        dtype = kwargs.get("dtype", args[0] if args else np.float64)
+        _check_materialization_cap(shape, np.dtype(dtype).itemsize)
+        return super().__new__(cls, shape, *args, **kwargs)
+
+    def __setstate__(self, state):
+        # pickle's BUILD re-allocates the array at the C level to the
+        # STATE's shape before any payload-length check (list payloads are
+        # not size-validated by numpy) — the same cap must gate it, and
+        # object dtypes (arbitrary embedded pickles) are refused outright
+        if isinstance(state, tuple) and len(state) >= 3:
+            shape, dtype = state[1], state[2]
+            try:
+                dt = np.dtype(dtype)
+            except TypeError:
+                dt = np.dtype("O")
+            if dt.hasobject:
+                raise pickle.UnpicklingError(
+                    "object-dtype arrays are not loadable by the torch-free "
+                    "reader — load with torch")
+            _check_materialization_cap(shape, dt.itemsize)
+        super().__setstate__(state)
 
 
 class _TorchUnpickler(pickle.Unpickler):
@@ -96,17 +193,74 @@ class _TorchUnpickler(pickle.Unpickler):
     def __init__(self, data_pkl: bytes, read_record):
         super().__init__(io.BytesIO(data_pkl))
         self._read_record = read_record
+        self._storages: dict = {}  # key → (raw, dtype): tied weights share
+        # one storage; torch.load dedups by key, so must we (else N aliases
+        # cost N reads + N transient buffers)
 
     def find_class(self, module: str, name: str) -> Any:
         if module == "torch._utils" and name in (
             "_rebuild_tensor_v2", "_rebuild_tensor"
         ):
             return _rebuild_tensor_v2
+        if module == "torch._utils" and name == "_rebuild_parameter":
+            # Parameter(tensor, requires_grad, hooks) → just the tensor; a
+            # stub here would silently discard the already-rebuilt data
+            return lambda t, *a: t
+        if module == "torch._tensor" and name == "_rebuild_from_type_v2":
+            # tensor SUBCLASSES (nn.Buffer, plain Tensor wrappers) pickle as
+            # _rebuild_from_type_v2(func, type, args, state): rebuild the
+            # underlying tensor, drop the subclass identity
+            return lambda func, typ, args, state=None: func(*args)
+        if ((module == "torch" or module.startswith("torch."))
+                and name.startswith("_rebuild")):
+            # any OTHER rebuild flavor (quantized, wrapper subclass, …):
+            # a stub would swallow the tensor silently — surface the escape
+            # hatch instead
+            raise pickle.UnpicklingError(
+                f"unsupported tensor rebuild {module}.{name} — load with "
+                "torch")
         if module == "collections" and name == "OrderedDict":
             import collections
 
             return collections.OrderedDict
-        if module.startswith("torch"):
+        if (module, name) in _NUMPY_ALLOWLIST:
+            # numpy scalars/arrays in checkpoint metadata (e.g. a
+            # numpy-averaged loss_rec in a lastepoch dict) — resolve the
+            # small reconstruction set torch's own weights_only unpickler
+            # allows, nothing else
+            if name == "bytes":
+                return bytes  # '__builtin__' (py2 spelling) isn't importable
+            if name == "ndarray":
+                # a bounded stand-in: numpy's _reconstruct bootstrap passes
+                # it as the subtype, but a crafted REDUCE(ndarray, (2**40,))
+                # would otherwise allocate terabytes from a tiny file,
+                # sidestepping the tensor-path materialization cap
+                return _BoundedNdarray
+            import importlib
+
+            resolved = getattr(importlib.import_module(module), name)
+            if name == "_reconstruct":
+                # the real C _reconstruct allocates via ndarray.__new__ at
+                # the C level, skipping _BoundedNdarray's Python __new__ —
+                # cap its shape argument here (itemsize ≥ 1, so an element
+                # count over the byte cap is always over the byte cap)
+                def _checked_reconstruct(subtype, shape, *args, **kwargs):
+                    try:  # the dtype rides the same untrusted stream: a
+                        # crafted 'V100000000' itemsize would otherwise
+                        # stretch an in-cap element count into a 100 GB
+                        # allocation
+                        itemsize = np.dtype(args[0]).itemsize if args else 1
+                    except TypeError:
+                        itemsize = 1
+                    _check_materialization_cap(shape, itemsize)
+                    return resolved(subtype, shape, *args, **kwargs)
+
+                return _checked_reconstruct
+            return resolved
+        if module == "torch" or module.startswith("torch."):
+            # torch proper only: torchvision/torch_* and every other foreign
+            # module stays refused below (a stub there would be silent data
+            # loss, not a passive singleton)
             return _NamedStub(module, name)
         # a checkpoint is a state_dict: tensors, containers, scalars. Any
         # other global is either corruption or a malicious reduce (pickle's
@@ -122,24 +276,222 @@ class _TorchUnpickler(pickle.Unpickler):
             raise pickle.UnpicklingError(f"unknown persistent id {kind!r}")
         name = (storage_type.name if isinstance(storage_type, _NamedStub)
                 else getattr(storage_type, "__name__", str(storage_type)))
-        dtype = _np_dtype(name)
-        if dtype is None:
-            raise ValueError(
-                "untyped torch storage needs the dtype from tensor metadata "
-                "— not produced by reference-era torch.save; load with torch")
+        dtype = _np_dtype(name)  # raises on UntypedStorage (byte-counted)
+        if key in self._storages:
+            raw, cached_dtype, cached_numel = self._storages[key]
+            # EVERY pid is validated, cached or not: a crafted second pid
+            # reusing the key with a different dtype/numel must not ride the
+            # first pid's validation
+            if cached_dtype != dtype or cached_numel != numel:
+                raise ValueError(
+                    f"storage {key}: conflicting persistent ids "
+                    f"({cached_dtype}/{cached_numel} vs {dtype}/{numel})")
+            return (raw, dtype)
         raw = self._read_record(key)
         expect = numel * dtype.itemsize
         if len(raw) != expect:
             raise ValueError(
                 f"storage {key}: {len(raw)} bytes on disk, expected {expect}")
+        self._storages[key] = (raw, dtype, numel)
         return (raw, dtype)
+
+
+#: numpy dtype name → torch storage-class name (inverse of _STORAGE_DTYPES)
+_DTYPE_STORAGES = {
+    "float32": "FloatStorage",
+    "float64": "DoubleStorage",
+    "float16": "HalfStorage",
+    "int64": "LongStorage",
+    "int32": "IntStorage",
+    "int16": "ShortStorage",
+    "int8": "CharStorage",
+    "uint8": "ByteStorage",
+    "bool": "BoolStorage",
+    "bfloat16": "BFloat16Storage",
+}
+
+
+class _FakeGlobal:
+    """Stands in for a torch global we must NAME in the pickle stream
+    (``torch.FloatStorage``, ``torch._utils._rebuild_tensor_v2``) without
+    importing torch: the writer below emits it as a plain GLOBAL opcode, and
+    the real torch.load resolves the name to the real object."""
+
+    def __init__(self, module: str, name: str):
+        self.module, self.name = module, name
+
+    def __call__(self, *a, **k):  # never invoked; pickle's save_reduce
+        raise TypeError("stand-in global")  # merely requires a callable
+
+
+class _TensorProxy:
+    """A numpy array destined to become a torch tensor in the stream."""
+
+    def __init__(self, arr: np.ndarray, key: int):
+        self.arr, self.key = arr, key
+
+
+class _TorchPickler(pickle._Pickler):  # Python impl: save_global overridable
+    """Emits torch's object graph: tensors as REDUCE of
+    ``torch._utils._rebuild_tensor_v2`` over a persistent storage id —
+    byte-compatible with what ``torch.save`` writes (protocol 2, the torch
+    default)."""
+
+    def save_global(self, obj, name=None):
+        if isinstance(obj, _FakeGlobal):
+            # GLOBAL by name, skipping pickle's import-and-verify (torch is
+            # exactly what this host doesn't have)
+            self.write(b"c" + obj.module.encode("utf-8") + b"\n"
+                       + obj.name.encode("utf-8") + b"\n")
+            self.memoize(obj)
+            return
+        return super().save_global(obj, name)
+
+    def persistent_id(self, obj):
+        if isinstance(obj, _PersistentStorage):
+            return obj.pid
+        return None
+
+    def reducer_override(self, obj):  # py3.8+: checked before dispatch
+        if isinstance(obj, _FakeGlobal):
+            # a string reduce means "save as a global of this name" — pickle
+            # routes it to save_global, where the override above emits the
+            # torch name without importing torch
+            return obj.name
+        if isinstance(obj, _TensorProxy):
+            a = obj.arr
+            storage = _FakeGlobal(
+                "torch", _DTYPE_STORAGES[a.dtype.name])
+            pid = _PersistentStorage(
+                ("storage", storage, str(obj.key), "cpu", int(a.size)))
+            stride = tuple(s // a.itemsize for s in a.strides)
+            return (_FakeGlobal("torch._utils", "_rebuild_tensor_v2"),
+                    (pid, 0, a.shape, stride, False,
+                     __import__("collections").OrderedDict()))
+        return NotImplemented
+
+
+class _PersistentStorage:
+    """Wrapper whose presence routes through the pickler's persistent-id
+    machinery (torch.load's unpickler calls persistent_load with the pid)."""
+
+    def __init__(self, pid):
+        self.pid = pid
+
+
+def save(obj: Any, path: str) -> None:
+    """``torch.save(obj, path)`` without torch: numpy arrays become torch
+    tensors on the reading side (real ``torch.load`` resolves the named
+    globals; :func:`load` resolves them to numpy). Arrays are written
+    C-contiguous."""
+    tensors: list[np.ndarray] = []
+    seen: dict[int, _TensorProxy] = {}  # same ndarray object → one storage
+    # record (torch.save preserves ties; views over a shared base still
+    # write separate records — this dedups identity, not aliasing)
+
+    def proxy(x):
+        if isinstance(x, np.ndarray):
+            if id(x) in seen:
+                return seen[id(x)]
+            if x.dtype.name not in _DTYPE_STORAGES:
+                raise ValueError(
+                    f"unsupported numpy dtype {x.dtype} for torch export — "
+                    f"supported: {sorted(_DTYPE_STORAGES)}")
+            # native byte order: dtype.name drops the order, so a '>f4'
+            # array would otherwise be written byte-swapped under the
+            # 'little' stamp — silently corrupt for torch.load
+            native = x.astype(x.dtype.newbyteorder("="), copy=False)
+            # reshape restores 0-dim: ascontiguousarray is at-least-1-d,
+            # which would round-trip a scalar tensor as shape [1]
+            arr = np.ascontiguousarray(native).reshape(x.shape)
+            tensors.append(arr)
+            seen[id(x)] = _TensorProxy(arr, len(tensors) - 1)
+            return seen[id(x)]
+        if isinstance(x, dict):
+            # keys go through the same conversion/refusal as values (a
+            # frozenset key would write a checkpoint only torch could
+            # reopen; a numpy-scalar key would trip weights_only loads)
+            return {proxy(k): proxy(v) for k, v in x.items()}
+        if isinstance(x, tuple) and hasattr(x, "_fields"):
+            # a namedtuple pickles as a GLOBAL of its defining module, which
+            # load()'s strict find_class refuses — writing one would produce
+            # a checkpoint only a torch host could reopen (asymmetry)
+            raise ValueError(
+                f"namedtuple {type(x).__name__} is not round-trippable "
+                "through the torch-free reader — convert to a plain "
+                "tuple/dict before export")
+        if isinstance(x, (list, tuple)):
+            return type(x)(proxy(v) for v in x)
+        if isinstance(x, np.generic):
+            # plain Python scalar: a numpy scalar would pickle via numpy
+            # reconstruction globals that torch>=2.6's default
+            # weights_only=True load refuses (measured) — .item() is
+            # lossless and loads everywhere
+            return x.item()
+        if x is None or isinstance(x, (bool, int, float, str, bytes)):
+            return x  # scalars this module's own load() can read back
+        # anything else (a set, a custom object, …) would pickle via a
+        # global that load()'s strict find_class refuses — a checkpoint only
+        # a torch host could reopen. Refuse symmetrically at write time.
+        raise ValueError(
+            f"unsupported value of type {type(x).__name__} for torch "
+            "export — checkpoints hold arrays, containers, and scalars")
+
+    import sys as _sys
+
+    if _sys.byteorder != "little":
+        # arr.tobytes() would be big-endian under the 'little' stamp below —
+        # a checkpoint real torch.load silently misreads
+        raise ValueError("torch-free writer supports little-endian hosts "
+                         "only — save with torch on this machine")
+    graph = proxy(obj)
+    buf = io.BytesIO()
+    _TorchPickler(buf, protocol=2).dump(graph)
+    import os
+
+    # write-then-rename: a kill mid-write must never leave a truncated zip
+    # at the destination (a corrupt warm-start file would crash every later
+    # run until hand-deleted — same contract as checkpoint.save_checkpoint)
+    tmp = path + ".writing"
+    if os.path.isdir(tmp):  # stale tmp DIR from a crashed orbax save that
+        import shutil  # used the same suffix — clear it or ZipFile raises
+        shutil.rmtree(tmp)  # IsADirectoryError on every later save
+    elif os.path.exists(tmp):
+        os.remove(tmp)
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED) as zf:
+            zf.writestr("archive/data.pkl", buf.getvalue())
+            zf.writestr("archive/version", "3")
+            zf.writestr("archive/byteorder", "little")
+            for i, arr in enumerate(tensors):
+                # arr is C-contiguous: a flat memoryview writes without the
+                # extra full copy tobytes() would make. Fallback for buffers
+                # memoryview/zipfile can't take (0-dim, exotic dtypes).
+                try:
+                    # cast('B'): len() must be the BYTE count — zipfile
+                    # sizes its zip64 decision from len(), and a typed view
+                    # reports elements
+                    payload = arr.reshape(-1).data.cast("B")
+                except (TypeError, ValueError):
+                    payload = arr.tobytes()
+                zf.writestr(f"archive/data/{i}", payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def load(path: str) -> Any:
     """``torch.load(path, map_location='cpu')`` without torch: the object
     graph with every tensor as a numpy array. Dicts come back as plain
     dict/OrderedDict; unknown torch objects as named stubs."""
-    with zipfile.ZipFile(path) as zf:
+    try:
+        zf_ctx = zipfile.ZipFile(path)
+    except zipfile.BadZipFile:
+        raise ValueError(
+            f"{path}: not a torch zip checkpoint (legacy pre-1.6 format?)"
+            " — load it with torch, or re-save it with a current torch")
+    with zf_ctx as zf:
         names = zf.namelist()
         pkl = [n for n in names if n.endswith("/data.pkl") or n == "data.pkl"]
         if not pkl:
@@ -151,9 +503,12 @@ def load(path: str) -> Any:
         bo_name = root + "byteorder"
         if bo_name in names:
             byteorder = zf.read(bo_name).decode().strip() or "little"
-        if byteorder != "little":
+        import sys as _sys
+
+        if byteorder != _sys.byteorder:
+            # np.frombuffer would silently misread cross-endian bytes
             raise ValueError(f"{path}: {byteorder}-endian checkpoint on a "
-                             "little-endian host — load with torch")
+                             f"{_sys.byteorder}-endian host — load with torch")
         data_pkl = zf.read(pkl[0])
 
         def read_record(key):

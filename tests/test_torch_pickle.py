@@ -154,3 +154,475 @@ def test_rejects_non_checkpoint_globals(tmp_path):
         zf.writestr("archive/data.pkl", buf.getvalue())
     with pytest.raises(pickle.UnpicklingError, match="refusing"):
         torch_pickle.load(p)
+
+
+def test_torch_free_writer_read_by_real_torch(tmp_path):
+    """save() output loads with real torch.load (weights_only both ways) and
+    with this module's own reader."""
+    import ml_dtypes
+
+    obj = {"state_dict": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                          "b": np.zeros(3, np.float16),
+                          "bf": np.full((2,), 0.5, dtype=ml_dtypes.bfloat16)},
+           "epoch": 5}
+    p = str(tmp_path / "native.pkl")
+    torch_pickle.save(obj, p)
+    for weights_only in (False, True):
+        got = torch.load(p, map_location="cpu", weights_only=weights_only)
+        assert got["epoch"] == 5
+        np.testing.assert_array_equal(got["state_dict"]["w"].numpy(),
+                                      obj["state_dict"]["w"])
+        np.testing.assert_array_equal(
+            got["state_dict"]["bf"].float().numpy(),
+            obj["state_dict"]["bf"].astype(np.float32))
+    rt = torch_pickle.load(p)
+    np.testing.assert_array_equal(rt["state_dict"]["w"],
+                                  obj["state_dict"]["w"])
+
+
+def test_save_torch_pkl_falls_back_without_torch(tmp_path, monkeypatch):
+    """A torch-less host still exports a bestloss.pkl that REAL torch.load
+    opens to the same state_dict the torch writer produces."""
+    import builtins
+
+    from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    import jax
+
+    model = DiffusionViT(**MODEL_CONFIGS["vit_tiny"])
+    params = model.init(
+        jax.random.PRNGKey(2),
+        np.zeros((1, 64, 64, 3), np.float32), np.zeros((1,), np.int32)
+    )["params"]
+    p_torch = str(tmp_path / "via_torch.pkl")
+    ckpt.save_torch_pkl(params, p_torch, patch_size=8)
+
+    real_import = builtins.__import__
+
+    def no_torch(name, *args, **kwargs):
+        if name == "torch" or name.startswith("torch."):
+            raise ImportError("torch disabled for this test")
+        return real_import(name, *args, **kwargs)
+
+    p_native = str(tmp_path / "via_native.pkl")
+    monkeypatch.setattr(builtins, "__import__", no_torch)
+    ckpt.save_torch_pkl(params, p_native, patch_size=8)
+    monkeypatch.undo()
+
+    a = torch.load(p_torch, map_location="cpu", weights_only=False)
+    b = torch.load(p_native, map_location="cpu", weights_only=False)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k].numpy(), b[k].numpy())
+        assert a[k].dtype == b[k].dtype
+
+
+def test_numpy_metadata_and_parameters_load(tmp_path):
+    """Checkpoint metadata carrying numpy scalars (common in lastepoch-style
+    dicts) and nn.Parameter leaves both load on the torch-free path."""
+    obj = {"loss_rec": np.float64(0.123), "metric": np.float32(0.05),
+           "state_dict": {"w": torch.nn.Parameter(torch.ones(2, 3))}}
+    p = str(tmp_path / "meta.pkl")
+    torch.save(obj, p)
+    got = torch_pickle.load(p)
+    assert got["loss_rec"] == pytest.approx(0.123)
+    np.testing.assert_array_equal(got["state_dict"]["w"], np.ones((2, 3)))
+
+
+def test_loaded_arrays_are_writable_and_owned(tmp_path):
+    """load() must hand back writable arrays that own their memory — a
+    read-only view over the zip record bytes breaks in-place callers and
+    pins whole storage buffers alive."""
+    torch.save({"w": torch.ones(4, 4)}, str(tmp_path / "w.pkl"))
+    got = torch_pickle.load(str(tmp_path / "w.pkl"))
+    arr = got["w"]
+    assert arr.flags.writeable and arr.flags.owndata
+    arr *= 2  # must not raise
+    np.testing.assert_array_equal(arr, 2 * np.ones((4, 4)))
+
+
+_NT = __import__("collections").namedtuple("_NT", ["a", "b"])
+
+
+def test_writer_refuses_namedtuples(tmp_path):
+    """A namedtuple pickles as a GLOBAL of its defining module, which the
+    strict reader refuses — the writer rejects it up front (write/read
+    symmetry) with a conversion hint."""
+    with pytest.raises(ValueError, match="not round-trippable"):
+        torch_pickle.save({"cfg": _NT(np.zeros(2, np.float32), 1)},
+                          str(tmp_path / "nt.pkl"))
+
+
+def test_writer_edge_dtypes_and_shapes(tmp_path):
+    """0-dim arrays keep their shape through real torch.load; explicitly
+    big-endian dtypes are normalized (not silently byte-swapped on disk);
+    unsupported dtypes fail with a clear error."""
+    p = str(tmp_path / "edge.pkl")
+    torch_pickle.save({"z": np.full((), 7.0, np.float32),  # true 0-dim
+                       "be": np.arange(4, dtype=">f4")}, p)
+    got = torch.load(p, map_location="cpu", weights_only=False)
+    assert got["z"].shape == torch.Size([])
+    assert float(got["z"]) == 7.0
+    np.testing.assert_array_equal(got["be"].numpy(), np.arange(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="unsupported numpy dtype"):
+        torch_pickle.save({"w": np.zeros(2, np.uint16)}, str(tmp_path / "u.pkl"))
+
+
+def test_writer_refuses_unreadable_values(tmp_path):
+    """save() rejects leaves its own load() couldn't read back (write/read
+    symmetry): a set would pickle via a builtins global the strict reader
+    refuses."""
+    with pytest.raises(ValueError, match="unsupported value"):
+        torch_pickle.save({"tags": {"a", "b"}}, str(tmp_path / "s.pkl"))
+    # numpy scalar metadata is written as a plain Python scalar so even
+    # torch>=2.6's default weights_only=True load accepts the file
+    p = str(tmp_path / "m.pkl")
+    torch_pickle.save({"loss": np.float64(0.5)}, p)
+    assert torch_pickle.load(p)["loss"] == pytest.approx(0.5)
+    got = torch.load(p, map_location="cpu", weights_only=True)
+    assert got["loss"] == pytest.approx(0.5) and isinstance(got["loss"], float)
+
+
+def test_oob_tensor_metadata_rejected(tmp_path):
+    """size/stride/offset come from the pickle stream independently of the
+    storage length — crafted values must raise, never read out of bounds."""
+    import io
+    import pickle
+    import zipfile
+
+    from ddim_cold_tpu.utils.torch_pickle import (_FakeGlobal,
+                                                  _PersistentStorage,
+                                                  _TorchPickler)
+
+    def craft(size, stride, offset=0):
+        pid = _PersistentStorage(
+            ("storage", _FakeGlobal("torch", "FloatStorage"), "0", "cpu", 2))
+        proxy_args = (pid, offset, size, stride, False,
+                      __import__("collections").OrderedDict())
+
+        class Raw:
+            def __reduce__(self):
+                return (_FakeGlobal("torch._utils", "_rebuild_tensor_v2"),
+                        proxy_args)
+
+        buf = io.BytesIO()
+        _TorchPickler(buf, protocol=2).dump({"w": Raw()})
+        p = str(tmp_path / "crafted.pkl")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("archive/data.pkl", buf.getvalue())
+            zf.writestr("archive/data/0", np.ones(2, np.float32).tobytes())
+        return p
+
+    with pytest.raises(ValueError, match="corrupt tensor metadata"):
+        torch_pickle.load(craft(size=(10**6,), stride=(1,)))
+    with pytest.raises(ValueError, match="corrupt tensor metadata"):
+        torch_pickle.load(craft(size=(2,), stride=(-1,), offset=1))
+    # sane metadata over the same storage still loads
+    got = torch_pickle.load(craft(size=(2,), stride=(1,)))
+    np.testing.assert_array_equal(got["w"], np.ones(2, np.float32))
+
+
+def test_tied_storages_share_one_read(tmp_path):
+    """Two tensors over one storage (tied weights) load correctly and the
+    storage record is materialized once."""
+    base = torch.arange(6, dtype=torch.float32)
+    t = {"a": base.view(2, 3), "b": base.view(3, 2)}
+    p = str(tmp_path / "tied.pkl")
+    torch.save(t, p)
+    got = torch_pickle.load(p)
+    np.testing.assert_array_equal(got["a"], base.view(2, 3).numpy())
+    np.testing.assert_array_equal(got["b"], base.view(3, 2).numpy())
+
+
+def test_unknown_rebuild_flavor_raises(tmp_path):
+    """A rebuild function this reader doesn't implement must surface the
+    'load with torch' escape hatch, not silently return a stub."""
+    import io
+    import pickle
+    import zipfile
+
+    from ddim_cold_tpu.utils.torch_pickle import _FakeGlobal, _TorchPickler
+
+    class Raw:
+        def __reduce__(self):
+            return (_FakeGlobal("torch._utils", "_rebuild_qtensor"), (1,))
+
+    buf = io.BytesIO()
+    _TorchPickler(buf, protocol=2).dump({"w": Raw()})
+    p = str(tmp_path / "q.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(pickle.UnpicklingError, match="load with torch"):
+        torch_pickle.load(p)
+
+
+def test_writer_dedups_shared_arrays(tmp_path):
+    """The same ndarray object written twice produces one storage record,
+    and torch.load returns tensors sharing storage (tied weights survive)."""
+    import zipfile
+
+    w = np.arange(8, dtype=np.float32)
+    p = str(tmp_path / "tied_w.pkl")
+    torch_pickle.save({"a": w, "b": w}, p)
+    with zipfile.ZipFile(p) as zf:
+        assert [n for n in zf.namelist() if "/data/" in n] == ["archive/data/0"]
+    got = torch.load(p, map_location="cpu", weights_only=False)
+    assert got["a"].data_ptr() == got["b"].data_ptr()  # tie preserved
+
+
+def test_materialization_cap_rejects_expand_bombs(tmp_path):
+    """0-stride/huge-size metadata (cheap view under torch.load, full copy
+    here) must hit the byte cap, not attempt a TiB allocation."""
+    import io
+    import zipfile
+
+    from ddim_cold_tpu.utils.torch_pickle import (_FakeGlobal,
+                                                  _PersistentStorage,
+                                                  _TorchPickler)
+
+    pid = _PersistentStorage(
+        ("storage", _FakeGlobal("torch", "FloatStorage"), "0", "cpu", 2))
+
+    class Raw:
+        def __reduce__(self):
+            return (_FakeGlobal("torch._utils", "_rebuild_tensor_v2"),
+                    (pid, 0, (10**12,), (0,), False,
+                     __import__("collections").OrderedDict()))
+
+    buf = io.BytesIO()
+    _TorchPickler(buf, protocol=2).dump({"w": Raw()})
+    p = str(tmp_path / "bomb.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+        zf.writestr("archive/data/0", np.ones(2, np.float32).tobytes())
+    with pytest.raises(ValueError, match="materialization cap"):
+        torch_pickle.load(p)
+
+
+def test_writer_validates_dict_keys(tmp_path):
+    """Keys get the same conversion/refusal as values: numpy-scalar keys
+    become Python scalars (weights_only-safe); non-round-trippable keys are
+    refused."""
+    p = str(tmp_path / "k.pkl")
+    torch_pickle.save({np.int64(3): np.ones(1, np.float32)}, p)
+    got = torch.load(p, map_location="cpu", weights_only=True)
+    assert list(got) == [3] and isinstance(list(got)[0], int)
+    assert torch_pickle.load(p)[3] is not None
+    with pytest.raises(ValueError, match="unsupported value"):
+        torch_pickle.save({frozenset({"a"}): 1}, str(tmp_path / "fk.pkl"))
+
+
+def test_writer_payload_byte_sizing(tmp_path):
+    """The zip record length must be the byte count, not the element count,
+    for multi-byte dtypes (zipfile's zip64 sizing reads len())."""
+    import zipfile
+
+    p = str(tmp_path / "f64.pkl")
+    torch_pickle.save({"w": np.arange(10, dtype=np.float64)}, p)
+    with zipfile.ZipFile(p) as zf:
+        assert zf.getinfo("archive/data/0").file_size == 80
+    np.testing.assert_array_equal(
+        torch.load(p, weights_only=True)["w"].numpy(),
+        np.arange(10, dtype=np.float64))
+
+
+def test_tensor_subclasses_load_as_base_arrays(tmp_path):
+    """nn.Buffer / tensor subclasses (pickled via _rebuild_from_type_v2)
+    load as their underlying arrays — never as silent stubs."""
+    p = str(tmp_path / "buf.pkl")
+    torch.save({"w": torch.nn.Buffer(torch.ones(2, 2))}, p)
+    got = torch_pickle.load(p)
+    np.testing.assert_array_equal(got["w"], np.ones((2, 2), np.float32))
+
+
+def test_empty_bytes_round_trip(tmp_path):
+    """Empty bytes pickle as the bytes global itself (non-empty go via
+    _codecs.encode) — both must round-trip through the torch-free pair."""
+    p = str(tmp_path / "eb.pkl")
+    torch_pickle.save({"empty": b"", "tag": b"abc"}, p)
+    got = torch_pickle.load(p)
+    assert got["empty"] == b"" and got["tag"] == b"abc"
+
+
+def test_conflicting_pids_on_shared_key_rejected(tmp_path):
+    """A second persistent id reusing a storage key with different dtype or
+    numel must be validated, not ride the first pid's cache entry."""
+    import io
+    import zipfile
+
+    from ddim_cold_tpu.utils.torch_pickle import (_FakeGlobal,
+                                                  _PersistentStorage,
+                                                  _TorchPickler)
+
+    def tensor_raw(storage_name, numel, size):
+        pid = _PersistentStorage(
+            ("storage", _FakeGlobal("torch", storage_name), "0", "cpu", numel))
+
+        class Raw:
+            def __reduce__(self):
+                return (_FakeGlobal("torch._utils", "_rebuild_tensor_v2"),
+                        (pid, 0, size, (1,), False,
+                         __import__("collections").OrderedDict()))
+
+        return Raw()
+
+    buf = io.BytesIO()
+    _TorchPickler(buf, protocol=2).dump(
+        {"a": tensor_raw("FloatStorage", 2, (2,)),
+         "b": tensor_raw("LongStorage", 99, (1,))})
+    p = str(tmp_path / "conflict.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+        zf.writestr("archive/data/0", np.ones(2, np.float32).tobytes())
+    with pytest.raises(ValueError, match="conflicting persistent ids"):
+        torch_pickle.load(p)
+
+
+def test_save_is_atomic(tmp_path, monkeypatch):
+    """A crash mid-write must leave no truncated zip at the destination (a
+    corrupt warm-start file would crash every later run)."""
+    import zipfile as zf_mod
+
+    p = str(tmp_path / "atomic.pkl")
+    torch_pickle.save({"w": np.ones(2, np.float32)}, p)  # good file exists
+
+    real_writestr = zf_mod.ZipFile.writestr
+    calls = {"n": 0}
+
+    def crashing_writestr(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise OSError("disk full")
+        return real_writestr(self, *a, **k)
+
+    monkeypatch.setattr(zf_mod.ZipFile, "writestr", crashing_writestr)
+    with pytest.raises(OSError, match="disk full"):
+        torch_pickle.save({"w": np.zeros(4, np.float32)}, p)
+    monkeypatch.undo()
+    assert not os.path.exists(p + ".writing")
+    got = torch_pickle.load(p)  # previous good file intact
+    np.testing.assert_array_equal(got["w"], np.ones(2, np.float32))
+
+
+def test_ndarray_allocation_bomb_rejected(tmp_path):
+    """REDUCE(numpy.ndarray, ((2**40,),)) in a crafted stream must hit the
+    materialization cap, not allocate terabytes."""
+    import io
+    import pickle
+    import zipfile
+
+    buf = io.BytesIO()
+    buf.write(b"\x80\x02")                    # PROTO 2
+    buf.write(b"cnumpy\nndarray\n")           # GLOBAL numpy ndarray
+    buf.write(b"\x8a\x08" + (2 ** 40).to_bytes(8, "little"))  # LONG1 2**40
+    buf.write(b"\x85")                        # TUPLE1 → (2**40,)  the shape
+    buf.write(b"\x85")                        # TUPLE1 → ((2**40,),) REDUCE args
+    buf.write(b"R")                           # REDUCE → ndarray((2**40,))
+    buf.write(b".")                           # STOP
+    p = str(tmp_path / "bomb2.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(Exception, match="materialization cap|refusing"):
+        torch_pickle.load(p)
+
+
+def test_reconstruct_allocation_bomb_rejected(tmp_path):
+    """REDUCE(numpy _reconstruct, (ndarray, (2**40,), b'b')) — the bootstrap
+    numpy itself uses — must hit the cap, not allocate at the C level."""
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    buf.write(b"\x80\x02")
+    buf.write(b"cnumpy._core.multiarray\n_reconstruct\n")
+    buf.write(b"cnumpy\nndarray\n")
+    buf.write(b"\x8a\x08" + (2 ** 40).to_bytes(8, "little"))
+    buf.write(b"\x85")                        # (2**40,)
+    buf.write(b"C\x01b")                      # SHORT_BINBYTES b'b'
+    buf.write(b"\x87")                        # TUPLE3 args
+    buf.write(b"R.")                          # REDUCE, STOP
+    p = str(tmp_path / "bomb3.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(Exception, match="materialization cap"):
+        torch_pickle.load(p)
+
+
+def test_reconstruct_large_itemsize_bomb_rejected(tmp_path):
+    """A crafted huge-itemsize dtype must not stretch an in-cap element
+    count into a huge allocation."""
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    buf.write(b"\x80\x02")
+    buf.write(b"cnumpy._core.multiarray\n_reconstruct\n")
+    buf.write(b"cnumpy\nndarray\n")
+    buf.write(b"M\x00\x04\x85")               # (1024,) — in-cap element count
+    buf.write(b"cnumpy\ndtype\n")
+    buf.write(b"U\x0aV100000000\x85R")        # dtype('V100000000')
+    buf.write(b"\x87R.")                      # TUPLE3, REDUCE, STOP
+    p = str(tmp_path / "bomb4.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(Exception, match="materialization cap"):
+        torch_pickle.load(p)
+
+
+def test_setstate_allocation_bomb_and_object_dtype_rejected(tmp_path):
+    """BUILD-opcode state must not re-allocate past the cap (list payloads
+    skip numpy's length check) and object dtypes are refused outright."""
+    import io
+    import zipfile
+
+    def crafted(shape_bytes, dtype_bytes):
+        buf = io.BytesIO()
+        buf.write(b"\x80\x02")
+        buf.write(b"cnumpy._core.multiarray\n_reconstruct\n")
+        buf.write(b"cnumpy\nndarray\nK\x00\x85C\x01b\x87R")  # bootstrap
+        buf.write(b"(K\x01")                                 # MARK, version 1
+        buf.write(shape_bytes)                               # shape tuple
+        buf.write(b"cnumpy\ndtype\n" + dtype_bytes + b"\x85R")
+        buf.write(b"\x89")                                   # False
+        buf.write(b"]K\x01a")                                # [1]
+        buf.write(b"t")                                      # TUPLE (state)
+        buf.write(b"b.")                                     # BUILD, STOP
+        p = str(tmp_path / "sb.pkl")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("archive/data.pkl", buf.getvalue())
+        return p
+
+    big_shape = b"\x8a\x05" + (10 ** 10).to_bytes(5, "little") + b"\x85"
+    with pytest.raises(Exception, match="materialization cap|object-dtype"):
+        torch_pickle.load(crafted(big_shape, b"U\x02i8"))
+    small_shape = b"K\x01\x85"
+    with pytest.raises(Exception, match="object-dtype"):
+        torch_pickle.load(crafted(small_shape, b"U\x01O"))
+
+
+def test_non_torch_packages_refused_not_stubbed(tmp_path):
+    """torchvision/torch_* globals must hit the loud refusal, not a silent
+    stub (stubs are for torch-proper passive singletons only)."""
+    import io
+    import pickle
+    import zipfile
+
+    buf = io.BytesIO()
+    buf.write(b"\x80\x02ctorchvision.transforms\nCompose\n)R.")
+    p = str(tmp_path / "tv.pkl")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("archive/data.pkl", buf.getvalue())
+    with pytest.raises(pickle.UnpicklingError, match="refusing"):
+        torch_pickle.load(p)
+
+
+def test_stale_writing_dir_cleared(tmp_path):
+    """A leftover '<path>.writing' DIRECTORY (crashed orbax save with the
+    same suffix) must be cleared, not crash every later save."""
+    p = str(tmp_path / "w.pkl")
+    os.makedirs(p + ".writing/sub")
+    torch_pickle.save({"w": np.ones(2, np.float32)}, p)
+    assert not os.path.exists(p + ".writing")
+    np.testing.assert_array_equal(torch_pickle.load(p)["w"],
+                                  np.ones(2, np.float32))
