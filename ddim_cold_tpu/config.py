@@ -100,6 +100,12 @@ class ExperimentConfig:
     # maintains ema ← d·ema + (1−d)·p each step, checkpointed alongside the
     # live params (bestloss_ema.ckpt + ema_params in lastepoch.ckpt)
     ema_decay: float = 0.0
+    # Switch-MoE (models/moe.py): >1 swaps each block's MLP for a top-1
+    # routed expert bank whose stacked params shard over an 'expert' mesh
+    # axis — the ep counterpart to mesh's data/model/seq/pipe. 1 = off.
+    num_experts: int = 1
+    moe_capacity_factor: float = 1.25  # per-expert queue: ceil(N·cf/E)
+    moe_aux_weight: float = 0.01  # Switch load-balance loss coefficient
 
     @property
     def effective_batch(self) -> int:
@@ -145,6 +151,8 @@ class ExperimentConfig:
             use_sincos_pos=self.use_sincos_pos,
             remat=self.remat,
             scan_blocks=self.scan_blocks,
+            num_experts=self.num_experts,
+            moe_capacity_factor=self.moe_capacity_factor,
         )
 
 
@@ -157,6 +165,26 @@ def _check_sp_mode(value: str) -> str:
 def _check_grad_accum(value: int) -> int:
     if value < 1:
         raise ValueError(f"grad_accum must be >= 1, got {value!r}")
+    return value
+
+
+def _check_num_experts(value: int) -> int:
+    if value < 1:
+        raise ValueError(f"num_experts must be >= 1, got {value!r}")
+    return value
+
+
+def _check_moe_capacity(value: float) -> float:
+    # cf ≤ 0 clamps every expert queue to one token: nearly all tokens
+    # overflow onto the residual and the MoE silently contributes nothing
+    if value <= 0.0:
+        raise ValueError(f"moe_capacity_factor must be > 0, got {value!r}")
+    return value
+
+
+def _check_moe_aux(value: float) -> float:
+    if value < 0.0:  # negative would actively REWARD routing imbalance
+        raise ValueError(f"moe_aux_weight must be >= 0, got {value!r}")
     return value
 
 
@@ -208,5 +236,9 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         microbatches=(int(raw["microbatches"]) if "microbatches" in raw else None),
         snapshot_epochs=int(raw.get("snapshot_epochs", 0)),
         ema_decay=_check_ema_decay(float(raw.get("ema_decay", 0.0))),
+        num_experts=_check_num_experts(int(raw.get("num_experts", 1))),
+        moe_capacity_factor=_check_moe_capacity(
+            float(raw.get("moe_capacity_factor", 1.25))),
+        moe_aux_weight=_check_moe_aux(float(raw.get("moe_aux_weight", 0.01))),
         grad_accum=_check_grad_accum(int(raw.get("grad_accum", 1))),
     )

@@ -1,0 +1,100 @@
+"""Switch-style mixture-of-experts MLP — the ``ep`` (expert-parallel) axis.
+
+The reference is data-parallel only (SURVEY.md C17) and its ViT uses a dense
+MLP (reference ViT.py:74-90); this module is TPU-native scale-out beyond
+parity: ``num_experts`` in the YAML swaps each block's MLP for a top-1
+routed expert bank (Switch Transformer, arXiv:2101.03961) whose stacked
+expert parameters shard over an ``expert`` mesh axis
+(parallel/sharding.py). The routing math is pure one-hot einsum
+dispatch/combine — static shapes, no gather/scatter, no host control flow —
+so XLA lays the token exchange onto ICI collectives by itself.
+
+Design notes (TPU-first):
+
+* routing is per batch row over its N tokens with per-expert capacity
+  ``C = ceil(N / E · capacity_factor)`` — everything stays (B, …)-leading,
+  so the ``data`` batch sharding composes untouched;
+* overflow tokens are DROPPED by the expert (their MLP delta is zero) and
+  ride the block's residual connection unchanged — the Switch paper's
+  behavior, and what keeps shapes static;
+* the router runs in float32 (softmax stability under bf16 compute);
+* the Switch load-balance auxiliary loss is ``sow``n into the ``losses``
+  collection; the train step adds ``moe_aux_weight ×`` its mean (it is a
+  no-op for consumers that do not mark the collection mutable, so the
+  sampler/eval paths need no changes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ddim_cold_tpu.models.init import trunc_normal
+
+Dtype = Any
+
+
+class SwitchMlp(nn.Module):
+    """Top-1 routed expert bank, drop-in for the block's dense ``Mlp``."""
+
+    num_experts: int
+    hidden_features: int
+    out_features: int
+    capacity_factor: float = 1.25
+    drop: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        import math
+
+        B, N, D = x.shape
+        E, H = self.num_experts, self.hidden_features
+        # per-expert queue length: static at trace time (N, E, cf all static)
+        C = max(1, math.ceil(N * self.capacity_factor / E))
+
+        # ---- router (f32: softmax stability under bf16 compute) ----------
+        wr = self.param("router", trunc_normal(std=0.02), (D, E), jnp.float32)
+        logits = jnp.einsum("bnd,de->bne", x.astype(jnp.float32), wr)
+        probs = jax.nn.softmax(logits, axis=-1)  # (B, N, E)
+        expert = jnp.argmax(probs, axis=-1)  # (B, N)
+        gate = jnp.max(probs, axis=-1)  # (B, N)
+
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (B, N, E)
+        # position of each token in its expert's queue (per batch row)
+        pos = jnp.cumsum(onehot, axis=1) - onehot  # (B, N, E)
+        within = pos < C
+        keep = onehot * within  # (B, N, E) — dropped tokens zero out here
+        slot = jax.nn.one_hot(
+            (pos * onehot).sum(-1).astype(jnp.int32), C, dtype=jnp.float32)
+        # dispatch/combine one-hots (B, N, E, C): static-shape einsum routing
+        dispatch = keep[..., None] * slot[:, :, None, :]
+        combine = dispatch * gate[..., None, None]
+
+        # ---- experts: stacked params, leading E shards over 'expert' -----
+        O = self.out_features
+        w1 = self.param("w1", trunc_normal(std=0.02), (E, D, H), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros_init(), (E, H), jnp.float32)
+        w2 = self.param("w2", trunc_normal(std=0.02), (E, H, O), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros_init(), (E, O), jnp.float32)
+
+        xe = jnp.einsum("bnd,bnec->becd", x.astype(self.dtype),
+                        dispatch.astype(self.dtype))
+        h = jnp.einsum("becd,edh->bech", xe, w1.astype(self.dtype))
+        h = h + b1.astype(self.dtype)[None, :, None, :]
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dropout(self.drop, deterministic=deterministic)(h)
+        ye = jnp.einsum("bech,ehd->becd", h, w2.astype(self.dtype))
+        ye = ye + b2.astype(self.dtype)[None, :, None, :]
+        y = jnp.einsum("becd,bnec->bnd", ye, combine.astype(self.dtype))
+        y = nn.Dropout(self.drop, deterministic=deterministic)(y)
+
+        # ---- Switch load-balance loss: E · Σ_e f_e · P_e -----------------
+        # f_e = fraction of tokens routed to e, P_e = mean router prob of e
+        frac = onehot.mean(axis=(0, 1))  # (E,)
+        mean_prob = probs.mean(axis=(0, 1))  # (E,)
+        self.sow("losses", "moe_aux", E * jnp.sum(frac * mean_prob))
+        return y

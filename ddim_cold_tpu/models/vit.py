@@ -230,6 +230,8 @@ class Block(nn.Module):
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None
     sp_mode: str = "ring"
+    num_experts: int = 1  # >1: Switch-MoE MLP (models/moe.py, 'expert' axis)
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -274,13 +276,27 @@ class Block(nn.Module):
                 return jnp.where(mask, y / keep, jnp.zeros_like(y)).astype(y.dtype)
 
         x = x + residual(y)
-        y = Mlp(
-            hidden_features=int(self.dim * self.mlp_ratio),
-            out_features=self.dim,
-            drop=self.drop,
-            dtype=self.dtype,
-            name="mlp",
-        )(ln("norm2")(x), deterministic=deterministic)
+        if self.num_experts > 1:
+            from ddim_cold_tpu.models.moe import SwitchMlp
+
+            mlp = SwitchMlp(
+                num_experts=self.num_experts,
+                hidden_features=int(self.dim * self.mlp_ratio),
+                out_features=self.dim,
+                capacity_factor=self.moe_capacity_factor,
+                drop=self.drop,
+                dtype=self.dtype,
+                name="moe",
+            )
+        else:
+            mlp = Mlp(
+                hidden_features=int(self.dim * self.mlp_ratio),
+                out_features=self.dim,
+                drop=self.drop,
+                dtype=self.dtype,
+                name="mlp",
+            )
+        y = mlp(ln("norm2")(x), deterministic=deterministic)
         x = x + residual(y)
         return x
 
@@ -388,6 +404,10 @@ class DiffusionViT(nn.Module):
     sp_mode: str = "ring"  # "ring" | "ulysses" (all-to-all head resharding)
     scan_blocks: bool = False  # nn.scan over depth: params stacked on a
     # leading layer axis (O(1) compile in depth; pipeline-parallel substrate)
+    num_experts: int = 1  # >1: Switch-MoE MLP per block (models/moe.py);
+    # expert params shard over an 'expert' mesh axis. Not composable with
+    # scan_blocks/pipe (sow under nn.scan; the aux loss would be lost).
+    moe_capacity_factor: float = 1.25
 
     @property
     def num_patches(self) -> int:
@@ -464,6 +484,10 @@ class DiffusionViT(nn.Module):
         if self.scan_blocks:
             if return_attention_layer is not None:
                 raise ValueError("attention probe requires scan_blocks=False")
+            if self.num_experts > 1:
+                raise ValueError(
+                    "num_experts > 1 requires scan_blocks=False (the MoE aux "
+                    "loss is sown per block; nn.scan would drop it)")
             blk = Block(
                 dim=E, num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 qkv_bias=self.qkv_bias, qk_scale=self.qk_scale,
@@ -510,6 +534,8 @@ class DiffusionViT(nn.Module):
                     batch_axis=self.batch_axis,
                     head_axis=self.head_axis,
                     sp_mode=self.sp_mode,
+                    num_experts=self.num_experts,
+                    moe_capacity_factor=self.moe_capacity_factor,
                 )
                 probe = (return_attention_layer is not None
                          and i == return_attention_layer % self.depth)

@@ -26,6 +26,8 @@ def layout_for_mesh(model, mesh: Mesh, params, *,
     if int(mesh.shape.get("pipe", 1)) > 1:
         return (pipeline_param_specs(params),
                 make_pipelined_apply(model, mesh, n_microbatch=n_microbatch))
-    if int(mesh.shape.get("model", 1)) > 1:
-        return param_partition_specs(params), None
+    shard_axes = tuple(a for a in ("model", "expert")
+                       if int(mesh.shape.get(a, 1)) > 1)
+    if shard_axes:
+        return param_partition_specs(params, axes=shard_axes), None
     return None, None

@@ -26,12 +26,22 @@ _COL_KERNELS = ("qkv", "fc1")  # output-dim sharded
 _ROW_KERNELS = ("proj", "fc2")  # input-dim sharded
 
 
-def _spec_for(path: tuple[str, ...]) -> P:
+def _spec_for(path: tuple[str, ...], value, axes) -> P:
     names = [getattr(k, "key", str(k)) for k in path]
     if "patch_embed" in names:
         return P()  # keep the token projection replicated (small, bandwidth-bound)
     leaf = names[-1]
     module = names[-2] if len(names) >= 2 else ""
+    if module == "moe":
+        # Switch-MoE expert banks (models/moe.py): stacked expert params
+        # carry a leading E axis → shard it over 'expert'; the router stays
+        # replicated (tiny, every token needs it)
+        if leaf == "router" or "expert" not in axes:
+            return P()
+        ndim = getattr(value, "ndim", 1)
+        return P("expert", *([None] * (ndim - 1)))
+    if "model" not in axes:
+        return P()
     if module in _COL_KERNELS:
         spec = P(None, "model") if leaf == "kernel" else P("model")
     elif module in _ROW_KERNELS:
@@ -45,10 +55,15 @@ def _spec_for(path: tuple[str, ...]) -> P:
     return spec
 
 
-def param_partition_specs(params):
+def param_partition_specs(params, axes=("model", "expert")):
     """PyTree of PartitionSpecs matching ``params``' structure (both the
-    unrolled ``blocks_{i}`` and stacked ``blocks`` layouts)."""
-    return jax.tree_util.tree_map_with_path(lambda path, _: _spec_for(path), params)
+    unrolled ``blocks_{i}`` and stacked ``blocks`` layouts). ``axes`` MUST
+    name only mesh axes the target mesh actually has — a spec referencing a
+    missing axis fails at shard time (layout_for_mesh derives the right set
+    from the mesh; direct callers owe the same care). The default covers
+    meshes that carry both sharding axes."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, v: _spec_for(p, v, tuple(axes)), params)
 
 
 def pipeline_param_specs(params, axis: str = "pipe"):

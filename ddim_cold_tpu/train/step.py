@@ -64,7 +64,8 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
 def make_train_step(model, apply_fn: Optional[Callable] = None,
                     prepare: Optional[Callable] = None,
                     ema_decay: float = 0.0,
-                    grad_accum: int = 1) -> Callable:
+                    grad_accum: int = 1,
+                    moe_aux_weight: float = 0.0) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
@@ -98,7 +99,17 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
     batch-dim-sharded mesh each slice stays evenly distributed over the
     'data' axis, where a contiguous split would park whole slices on one
     device and idle the rest.
+
+    ``moe_aux_weight`` > 0 (Switch-MoE models only, models/moe.py): the
+    forward runs with the ``losses`` collection mutable and the Switch
+    load-balance loss — the mean of the per-block ``sow``n values — is
+    added to the smooth-L1 with this coefficient.
     """
+    moe_on = moe_aux_weight > 0 and getattr(model, "num_experts", 1) > 1
+    if moe_on and apply_fn is not None:
+        raise ValueError(
+            "moe_aux_weight requires the plain model.apply path (custom "
+            "apply_fn hooks don't thread the 'losses' collection)")
     apply_fn = apply_fn or model.apply
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -119,6 +130,15 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
         dropout_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params, noisy, target, t, drop_rng):
+            if moe_on:
+                pred, aux_vars = apply_fn(
+                    {"params": params}, noisy, t, deterministic=False,
+                    rngs={"dropout": drop_rng}, mutable=["losses"],
+                )
+                sown = jax.tree.leaves(aux_vars.get("losses", {}))
+                aux = (sum(jnp.sum(s) for s in sown) / len(sown)
+                       if sown else 0.0)
+                return smooth_l1(pred, target) + moe_aux_weight * aux
             pred = apply_fn(
                 {"params": params}, noisy, t, deterministic=False,
                 rngs={"dropout": drop_rng},

@@ -207,6 +207,11 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
                 "pipeline parallelism composes with data parallelism only — "
                 f"drop 'model'/'seq' from mesh {dict(mesh_shape)}")
         kwargs["scan_blocks"] = True
+    if config.num_experts > 1 and "pipe" in mesh_shape:
+        raise ValueError(
+            "num_experts > 1 does not compose with pipeline parallelism "
+            "(the pipe substrate is scan_blocks, which drops the MoE aux "
+            "loss) — use an 'expert' (and 'data') mesh axis instead")
     if "seq" in mesh_shape:
         # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
         # tp axis the ring keeps heads sharded over it (no qkv all-gather)
@@ -251,6 +256,12 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             config = dataclasses.replace(config, num_devices=ndev)
         mesh_shape = {"data": ndev}
     mesh = make_mesh(mesh_shape, devices=avail[: int(np.prod(list(mesh_shape.values())))])
+    exp_size = int(mesh.shape.get("expert", 1))
+    if exp_size > 1 and (config.num_experts <= 1
+                         or config.num_experts % exp_size):
+        raise ValueError(
+            f"mesh 'expert' axis of {exp_size} needs num_experts (got "
+            f"{config.num_experts}) set and divisible by it")
 
     # -- data --------------------------------------------------------------
     # per-device batch × devices = the global batch fed each step; sharding on
@@ -347,9 +358,20 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
             # they differ (measured) — validated below like the pkl branch
             loaded = ckpt.restore_checkpoint(init_path, state.params)
         elif jax.process_index() == 0:
-            # torch-less hosts still write the pkl (checkpoint.save_torch_pkl
-            # falls back to the native zip-format writer internally)
-            ckpt.save_torch_pkl(state.params, init_path, config.patch_size)
+            # best-effort convenience cache (same seed reproduces the init
+            # regardless): torch-less hosts still write the pkl via the
+            # native writer; anything the pkl bridge refuses (e.g. MoE
+            # params have no reference torch layout) falls back to orbax —
+            # the isdir branch above loads that form on the next run
+            try:
+                ckpt.save_torch_pkl(state.params, init_path, config.patch_size)
+            except Exception as e:  # noqa: BLE001
+                print_log(f"init pkl export unavailable ({e}); "
+                          "persisting orbax instead", log)
+                if os.path.isfile(init_path):  # partial file from the failed
+                    os.remove(init_path)  # write would poison later runs AND
+                    # break save_checkpoint's dir rename onto it
+                ckpt.save_checkpoint(init_path, state.params)
         if loaded is not None:
             _check_loaded_params(loaded, state.params, init_path)
             state = state.replace(params=loaded)
@@ -414,9 +436,11 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
     specs, apply_fn = layout_for_mesh(model, mesh, state.params,
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
-    train_step = make_train_step(model, apply_fn, prepare=prepare,
-                                 ema_decay=config.ema_decay,
-                                 grad_accum=config.grad_accum)
+    train_step = make_train_step(
+        model, apply_fn, prepare=prepare,
+        ema_decay=config.ema_decay, grad_accum=config.grad_accum,
+        moe_aux_weight=(config.moe_aux_weight
+                        if config.num_experts > 1 else 0.0))
     eval_step = make_eval_step(model, apply_fn, prepare=eval_prepare)
     writer = ScalarWriter(run_dir)
     step_rng = jax.random.PRNGKey(config.seed + 1)
@@ -531,7 +555,10 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                         # from; saved beside (never instead of) the live best
                         ckpt.save_checkpoint(
                             os.path.join(run_dir, "bestloss_ema.ckpt"), ema)
-                    if jax.process_index() == 0 and _fully_addressable(params):
+                    if (jax.process_index() == 0 and _fully_addressable(params)
+                            and config.num_experts == 1):
+                        # (MoE params have no reference torch layout — the
+                        # bridge refuses them, so don't retry every epoch)
                         # best-effort bridge export (torch-less hosts fall
                         # back to the native writer internally): a refused
                         # export must never kill the run at its best-loss

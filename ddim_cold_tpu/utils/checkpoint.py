@@ -118,6 +118,11 @@ def torch_state_dict_from_flax(params, patch_size: int) -> dict:
     names). Accepts both block layouts — a stacked ``blocks`` subtree
     (scan_blocks models) is unstacked first."""
     params = unstack_block_params(params)
+    if any("moe" in blk for blk in params.values() if isinstance(blk, dict)):
+        raise ValueError(
+            "MoE params (num_experts > 1) have no reference torch layout — "
+            "the torch-pkl bridge covers the reference's dense architecture "
+            "only; use the orbax checkpoints for MoE runs")
     g = lambda *ks: np.asarray(_dig(params, ks))
     p = patch_size
     pk = g("patch_embed", "proj", "kernel")  # (p²C, E)
@@ -196,9 +201,15 @@ def save_torch_pkl(params, path: str, patch_size: int) -> None:
     except ImportError:
         from ddim_cold_tpu.utils import torch_pickle
 
-        torch_pickle.save(sd_np, path)
+        torch_pickle.save(sd_np, path)  # write-then-rename internally
         return
-    torch.save({k: torch.from_numpy(v) for k, v in sd_np.items()}, path)
+    # same atomicity as the native writer: torch.save writes the destination
+    # directly, and a crash mid-write would leave a truncated file that
+    # poisons every later warm start
+    from ddim_cold_tpu.utils.torch_pickle import atomic_replace
+
+    with atomic_replace(path) as tmp:
+        torch.save({k: torch.from_numpy(v) for k, v in sd_np.items()}, tmp)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,35 @@ from typing import Any
 
 import numpy as np
 
+import contextlib
+import os as _os
+
+
+@contextlib.contextmanager
+def atomic_replace(path: str):
+    """Write-then-rename: yields a tmp path; on clean exit the tmp replaces
+    ``path`` atomically, on error the tmp is removed — a kill mid-write can
+    never leave a truncated file at the destination (which would poison
+    every later load until hand-deleted). Clears stale tmp leftovers of
+    either kind (a dir from a crashed orbax save shares the suffix). The
+    ONE owner of this protocol for single-FILE checkpoint artifacts
+    (orbax's directory swap in checkpoint.save_checkpoint is its own,
+    two-rename protocol)."""
+    tmp = path + ".writing"
+    if _os.path.isdir(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    elif _os.path.exists(tmp):
+        _os.remove(tmp)
+    try:
+        yield tmp
+        _os.replace(tmp, path)
+    finally:
+        if _os.path.exists(tmp):
+            _os.remove(tmp)
+
+
 #: largest tensor this reader will materialize (it copies, unlike
 #: torch.load's cheap views) — far above any in-scope checkpoint, far below
 #: a crafted 0-stride/huge-size allocation bomb
@@ -447,18 +476,7 @@ def save(obj: Any, path: str) -> None:
     graph = proxy(obj)
     buf = io.BytesIO()
     _TorchPickler(buf, protocol=2).dump(graph)
-    import os
-
-    # write-then-rename: a kill mid-write must never leave a truncated zip
-    # at the destination (a corrupt warm-start file would crash every later
-    # run until hand-deleted — same contract as checkpoint.save_checkpoint)
-    tmp = path + ".writing"
-    if os.path.isdir(tmp):  # stale tmp DIR from a crashed orbax save that
-        import shutil  # used the same suffix — clear it or ZipFile raises
-        shutil.rmtree(tmp)  # IsADirectoryError on every later save
-    elif os.path.exists(tmp):
-        os.remove(tmp)
-    try:
+    with atomic_replace(path) as tmp:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED) as zf:
             zf.writestr("archive/data.pkl", buf.getvalue())
             zf.writestr("archive/version", "3")
@@ -475,10 +493,6 @@ def save(obj: Any, path: str) -> None:
                 except (TypeError, ValueError):
                     payload = arr.tobytes()
                 zf.writestr(f"archive/data/{i}", payload)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.remove(tmp)
 
 
 def load(path: str) -> Any:
