@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Measure parallelism-layout overhead: dp vs dp×pipe vs dp×seq vs dp×tp.
+"""Measure parallelism-layout overhead: dp vs dp×{pipe,seq,tp,expert}.
 
 The round-1 suite proved these layouts *correct* (gradient equivalence); this
 script measures what each one *costs*, so the README can say when to use
@@ -14,7 +14,11 @@ that needs a real slice; what it isolates is the schedule/collective overhead
 each layout adds.)
 
 Writes one JSON line per layout:
-    {"layout": "dp4_pipe2", "ms_per_step": ..., "vs_dp": ...}
+    {"layout": "dp4_pipe2", "ms_per_step": ..., "vs_dp": ..., "baseline": ...}
+where ``baseline`` names the denominator row: dense layouts ratio against
+plain dp, the MoE rows against the SAME MoE model on plain dp (comparing MoE
+to the dense baseline would conflate model cost with layout cost — the two
+families' ``vs_dp`` values are not cross-comparable).
 """
 
 from __future__ import annotations
@@ -69,11 +73,17 @@ def main(argv=None):
     from ddim_cold_tpu.train.trainer import build_model
 
     n = args.devices
+    # (mesh, config overrides): the two MoE rows isolate the ep LAYOUT cost
+    # by comparing the same MoE model on plain dp vs dp×ep — comparing MoE
+    # to the dense dp baseline would conflate model cost with layout cost
     layouts = {
-        f"dp{n}": {"data": n},
-        f"dp{n//2}_pipe2": {"data": n // 2, "pipe": 2},
-        f"dp{n//2}_seq2": {"data": n // 2, "seq": 2},
-        f"dp{n//2}_tp2": {"data": n // 2, "model": 2},
+        f"dp{n}": ({"data": n}, {}),
+        f"dp{n//2}_pipe2": ({"data": n // 2, "pipe": 2}, {}),
+        f"dp{n//2}_seq2": ({"data": n // 2, "seq": 2}, {}),
+        f"dp{n//2}_tp2": ({"data": n // 2, "model": 2}, {}),
+        f"moe_dp{n}": ({"data": n}, {"num_experts": 4}),
+        f"moe_dp{n//2}_ep2": ({"data": n // 2, "expert": 2},
+                              {"num_experts": 4}),
     }
 
     rng = np.random.RandomState(0)
@@ -84,12 +94,12 @@ def main(argv=None):
     )
 
     results = {}
-    for name, mesh_shape in layouts.items():
+    for name, (mesh_shape, extra) in layouts.items():
         cfg = ExperimentConfig(
             exp_name="pbench", amp=True, batch_size=args.batch,
             image_size=(args.img, args.img), patch_size=args.patch,
             embed_dim=args.embed, depth=args.depth, head=args.heads,
-            mesh=mesh_shape,
+            mesh=mesh_shape, **extra,
         )
         mesh = make_mesh(mesh_shape)
         model = build_model(cfg, mesh=mesh)
@@ -118,10 +128,14 @@ def main(argv=None):
               f"{1000*dt:8.2f} ms/step", file=sys.stderr)
 
     base = results[f"dp{n}"]
+    moe_base = results.get(f"moe_dp{n}", base)
     for name, dt in results.items():
+        is_moe = name.startswith("moe_")
+        ref = moe_base if is_moe else base
         print(json.dumps({
             "layout": name, "ms_per_step": round(1000 * dt, 2),
-            "vs_dp": round(dt / base, 3),
+            "vs_dp": round(dt / ref, 3),
+            "baseline": f"moe_dp{n}" if is_moe else f"dp{n}",
             "note": "8 virtual CPU devices share one core: ratio ≈ total-work "
                     "overhead of the layout, not ICI speedup",
         }))
