@@ -30,7 +30,10 @@ sys.path.insert(0, HERE)
 @click.option("--init-random", is_flag=True,
               help="Use random init instead of a checkpoint (smoke runs).")
 @click.option("--seed", default=0, help="Sampling rng seed.")
-def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
+@click.option("--eta", default=0.0,
+              help="Stochastic-DDIM noise scale (DDIM paper interpolation; "
+                   "0 = the reference's deterministic sampler).")
+def main(sample_n, acc_k, config_name, checkpoint, init_random, seed, eta):
     """Batch sampling + denoise-sequence figure (reference ViT.py main)."""
     import jax
     import jax.numpy as jnp
@@ -74,7 +77,7 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
 
     n_seq = 6
     seq = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed), k=100,
-                               n=n_seq, return_sequence=True)
+                               n=n_seq, return_sequence=True, eta=eta)
     # rows = samples, cols = trajectory frames (reference figure layout)
     frames = jnp.swapaxes(seq, 0, 1).reshape(-1, *seq.shape[2:])
     out = save_grid(frames, get_next_path(os.path.join(saved, "denoise_sequence.png")),
@@ -82,7 +85,7 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed):
     print(f"wrote {out}")
 
     img = sampling.ddim_sample(model, params, jax.random.PRNGKey(seed + 1),
-                               k=acc_k, n=sample_n, mesh=mesh)
+                               k=acc_k, n=sample_n, mesh=mesh, eta=eta)
     nrows, ncols = grid_shape(sample_n)
     out = save_grid(img, get_next_path(os.path.join(saved, "samples.png")),
                     nrows=nrows, ncols=ncols)

@@ -45,43 +45,59 @@ def forward_noise(rng: jax.Array, img: jax.Array, t_start: int, total_steps: int
     return math.sqrt(alpha) * img + math.sqrt(1.0 - alpha) * eps
 
 
-@partial(jax.jit, static_argnames=("model", "k", "t_start"))
-def _ddim_scan_sequence(model, params, x_init, *, k: int, t_start: Optional[int]):
-    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start)
+def _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta: float):
+    """One reverse-step update shared by both scan variants: the affine
+    (cx, cx0) move plus, for stochastic DDIM (eta>0), fresh per-step noise
+    keyed by folding t — one definition so the sequence and last-only paths
+    can never sample from different stochastic processes."""
+    x_next = c1 * x + c2 * x0
+    if eta:
+        z = jax.random.normal(jax.random.fold_in(noise_rng, t),
+                              x.shape, x.dtype)
+        x_next = x_next + cz * z
+    return x_next
+
+
+def _scan_inputs(coeffs):
+    return (jnp.asarray(coeffs.t_seq), jnp.asarray(coeffs.cx),
+            jnp.asarray(coeffs.cx0), jnp.asarray(coeffs.cz))
+
+
+@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta"))
+def _ddim_scan_sequence(model, params, x_init, noise_rng, *, k: int,
+                        t_start: Optional[int], eta: float = 0.0):
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
     n = x_init.shape[0]
 
     def step(x, inputs):
-        t, c1, c2 = inputs
+        t, c1, c2, cz = inputs
         x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
-        return c1 * x + c2 * x0, x0
+        return _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta), x0
 
-    _, x0_out = jax.lax.scan(
-        step, x_init, (jnp.asarray(coeffs.t_seq), jnp.asarray(coeffs.cx), jnp.asarray(coeffs.cx0))
-    )
+    _, x0_out = jax.lax.scan(step, x_init, _scan_inputs(coeffs))
     # frames: the initial noisy image, then every x̂0 prediction — matching the
     # reference's recorded trajectory (ViT.py:244,254).
     frames = jnp.concatenate([x_init[None], x0_out], axis=0)
     return (frames + 1.0) / 2.0
 
 
-@partial(jax.jit, static_argnames=("model", "k", "t_start"))
-def _ddim_scan_last(model, params, x_init, *, k: int, t_start: Optional[int]):
-    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start)
+@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta"))
+def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
+                    t_start: Optional[int], eta: float = 0.0):
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
     n = x_init.shape[0]
 
     def step(carry, inputs):
         x, _ = carry
-        t, c1, c2 = inputs
+        t, c1, c2, cz = inputs
         x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
-        return (c1 * x + c2 * x0, x0), None
+        return (_ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta),
+                x0), None
 
     (_, x0_last), _ = jax.lax.scan(
-        step,
-        (x_init, jnp.zeros_like(x_init)),
-        (jnp.asarray(coeffs.t_seq), jnp.asarray(coeffs.cx), jnp.asarray(coeffs.cx0)),
-    )
+        step, (x_init, jnp.zeros_like(x_init)), _scan_inputs(coeffs))
     # the sample is the LAST x̂0 prediction, not the final noisy state
     # (reference ViT.py:236 returns denoised_img).
     return (x0_last + 1.0) / 2.0
@@ -110,6 +126,7 @@ def ddim_sample(
     t_start: Optional[int] = None,
     return_sequence: bool = False,
     mesh=None,
+    eta: float = 0.0,
 ) -> jax.Array:
     """k-strided DDIM sampling; returns images in [0, 1], NHWC.
 
@@ -121,16 +138,29 @@ def ddim_sample(
     the initial noise plus every x̂0 prediction (the denoise-sequence figure).
     With a ``mesh``, the batch is sharded over its 'data' axis and the scan
     runs SPMD across the chips.
+
+    ``eta`` interpolates toward stochastic (DDPM-like) sampling per the DDIM
+    paper (schedule.ddim_coefficients; beyond-parity, default 0 = the
+    reference's deterministic path, bit-exact). ``eta`` > 0 draws per-step
+    noise from ``rng``, which is then required even with ``x_init``.
     """
+    if eta and rng is None:
+        raise ValueError("eta > 0 draws per-step noise — pass rng")
     if x_init is None:
         if rng is None:
             raise ValueError("ddim_sample needs either rng or x_init")
         H, W = model.img_size
         x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
     x_init = _shard_init(x_init, mesh)
+    # distinct fold: with a fresh start, rng already produced x_init — the
+    # per-step noise must not be correlated with it
+    noise_rng = (jax.random.fold_in(rng, 0xD1F) if rng is not None
+                 else jax.random.PRNGKey(0))
     if return_sequence:
-        return _ddim_scan_sequence(model, params, x_init, k=k, t_start=t_start)
-    return _ddim_scan_last(model, params, x_init, k=k, t_start=t_start)
+        return _ddim_scan_sequence(model, params, x_init, noise_rng,
+                                   k=k, t_start=t_start, eta=eta)
+    return _ddim_scan_last(model, params, x_init, noise_rng,
+                           k=k, t_start=t_start, eta=eta)
 
 
 def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10) -> jax.Array:

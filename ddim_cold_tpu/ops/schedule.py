@@ -76,33 +76,54 @@ class DDIMCoefficients(NamedTuple):
     t_seq: np.ndarray  # (n,) int32 — model conditioning step at each iteration
     cx: np.ndarray  # (n,) float32 — coefficient on the current noisy image
     cx0: np.ndarray  # (n,) float32 — coefficient on the clamped x0 prediction
+    cz: np.ndarray  # (n,) float32 — σ_t on fresh noise (all-zero when eta=0)
 
 
-def ddim_coefficients(total_steps: int, k: int, t_start: int | None = None) -> DDIMCoefficients:
+def ddim_coefficients(total_steps: int, k: int, t_start: int | None = None,
+                      eta: float = 0.0) -> DDIMCoefficients:
     """Precompute the affine DDIM-update coefficients for a k-strided schedule.
 
     Deviation from the reference: when ``t+1−k < 0`` (possible for stride k not
     dividing T−1 nicely) the reference's ``math.sqrt`` would raise; we clamp the
     argument to 0 (ᾱ → 1, i.e. jump straight to the clean image). For every k
     used by the reference CLIs (1, 10, 20, 50, 100) the clamp never triggers.
+
+    ``eta`` > 0 is the DDIM paper's stochastic interpolation (arXiv:2010.02502
+    eq. 16, beyond-parity — the reference is deterministic-only): per-step
+    noise scale ``σ_t = η·√((1−a_tk)/(1−a_t))·√(1−a_t/a_tk)`` with the
+    ε-direction rescaled to ``√(1−a_tk−σ_t²)``. η=0 keeps the EXACT reference
+    arithmetic (same operation order, bit-identical coefficients — the
+    η-generalized expression is algebraically equal but rounds differently).
     """
     t_seq = ddim_time_sequence(total_steps, k, t_start)
     T = float(total_steps)
     cx = np.empty(len(t_seq), dtype=np.float64)
     cx0 = np.empty(len(t_seq), dtype=np.float64)
+    cz = np.zeros(len(t_seq), dtype=np.float64)
     for i, t in enumerate(t_seq):
         a_t = 1.0 - math.sqrt((t + 1.0) / T) + ALPHA_EPS
         a_tk = 1.0 - math.sqrt(max(t + 1.0 - k, 0.0) / T)
-        # d = √((1−a_tk)/a_tk) − √((1−a_t)/a_t)
-        d = math.sqrt((1.0 - a_tk) / a_tk) - math.sqrt((1.0 - a_t) / a_t)
-        s = math.sqrt(a_tk)
-        # x' = s·x/√a_t + s·d·noise ;  noise = x/√(1−a_t) − √a_t/√(1−a_t)·x0
-        cx[i] = s / math.sqrt(a_t) + s * d / math.sqrt(1.0 - a_t)
-        cx0[i] = -s * d * math.sqrt(a_t) / math.sqrt(1.0 - a_t)
+        if eta == 0.0:
+            # d = √((1−a_tk)/a_tk) − √((1−a_t)/a_t)
+            d = math.sqrt((1.0 - a_tk) / a_tk) - math.sqrt((1.0 - a_t) / a_t)
+            s = math.sqrt(a_tk)
+            # x' = s·x/√a_t + s·d·noise ;  noise = x/√(1−a_t) − √a_t/√(1−a_t)·x0
+            cx[i] = s / math.sqrt(a_t) + s * d / math.sqrt(1.0 - a_t)
+            cx0[i] = -s * d * math.sqrt(a_t) / math.sqrt(1.0 - a_t)
+        else:
+            # x' = √a_tk·x0 + √(1−a_tk−σ²)·ε + σ·z,  ε = (x−√a_t·x0)/√(1−a_t)
+            sigma = eta * math.sqrt((1.0 - a_tk) / (1.0 - a_t)) * math.sqrt(
+                max(1.0 - a_t / a_tk, 0.0))
+            ce = math.sqrt(max(1.0 - a_tk - sigma * sigma, 0.0)) / math.sqrt(
+                1.0 - a_t)
+            cx[i] = ce
+            cx0[i] = math.sqrt(a_tk) - ce * math.sqrt(a_t)
+            cz[i] = sigma
     return DDIMCoefficients(
         t_seq=t_seq.astype(np.int32),
         cx=cx.astype(np.float32),
         cx0=cx0.astype(np.float32),
+        cz=cz.astype(np.float32),
     )
 
 

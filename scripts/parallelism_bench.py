@@ -128,7 +128,8 @@ def main(argv=None):
               f"{1000*dt:8.2f} ms/step", file=sys.stderr)
 
     base = results[f"dp{n}"]
-    moe_base = results.get(f"moe_dp{n}", base)
+    moe_base = results[f"moe_dp{n}"]  # missing baseline must fail loudly,
+    # never silently ratio the moe rows against dense dp
     for name, dt in results.items():
         is_moe = name.startswith("moe_")
         ref = moe_base if is_moe else base

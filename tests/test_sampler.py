@@ -208,3 +208,40 @@ def test_mesh_sharded_sampling_matches_single_device(model_and_params):
     cold_sharded = np.asarray(
         sampling.cold_sample(model, params, rng, n=8, levels=4, mesh=mesh))
     np.testing.assert_allclose(cold_sharded, cold_single, rtol=2e-5, atol=2e-6)
+
+
+def test_eta_zero_coefficients_bit_identical_and_generalized_close():
+    """eta=0 keeps the reference arithmetic untouched (bitwise — the parity
+    path must not change); the eta-generalized expression agrees with it
+    algebraically (allclose at a tiny eta)."""
+    from ddim_cold_tpu.ops import schedule
+
+    base = schedule.ddim_coefficients(2000, 20)
+    again = schedule.ddim_coefficients(2000, 20, eta=0.0)
+    np.testing.assert_array_equal(base.cx, again.cx)
+    np.testing.assert_array_equal(base.cx0, again.cx0)
+    assert not base.cz.any()
+    gen = schedule.ddim_coefficients(2000, 20, eta=1e-12)
+    np.testing.assert_allclose(gen.cx, base.cx, rtol=1e-5)
+    np.testing.assert_allclose(gen.cx0, base.cx0, rtol=1e-5, atol=1e-7)
+
+
+def test_eta_stochastic_sampling(model_and_params):
+    """eta>0: finite [0,1] output, reproducible per rng, different from the
+    deterministic path, and rng becomes required."""
+    import pytest
+
+    from ddim_cold_tpu.ops import sampling
+
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(3)
+    det = sampling.ddim_sample(model, params, rng, k=500, n=2)
+    sto = sampling.ddim_sample(model, params, rng, k=500, n=2, eta=1.0)
+    sto2 = sampling.ddim_sample(model, params, rng, k=500, n=2, eta=1.0)
+    a = np.asarray(sto)
+    assert np.isfinite(a).all() and a.min() >= 0.0 and a.max() <= 1.0
+    np.testing.assert_array_equal(a, np.asarray(sto2))  # same key → same draw
+    assert np.abs(a - np.asarray(det)).max() > 1e-4  # the noise did something
+    with pytest.raises(ValueError, match="pass rng"):
+        sampling.ddim_sample(model, params, x_init=np.asarray(det) * 2 - 1,
+                             k=500, eta=0.5)
