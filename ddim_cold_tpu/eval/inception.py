@@ -21,8 +21,7 @@ statistics (inference only).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence
-
+from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

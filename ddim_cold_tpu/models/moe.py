@@ -26,7 +26,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

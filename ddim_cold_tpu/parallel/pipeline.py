@@ -24,7 +24,6 @@ manual over the whole mesh); the trainer enforces that.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

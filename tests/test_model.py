@@ -2,7 +2,6 @@
 time-embedding semantics, torch-oracle forward parity (torch cpu is available
 in this image as a test-only dependency)."""
 
-import math
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
 
 WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 
