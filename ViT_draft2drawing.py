@@ -53,7 +53,11 @@ def img2tensor(path: str, img_size):
               help="Two images to slerp-interpolate between (C25).")
 @click.option("--cold-n", default=49, help="Samples in the cold grid.")
 @click.option("--seed", default=0, help="Sampling rng seed.")
-def main(config_name, checkpoint, init_random, draft, interpolate, cold_n, seed):
+@click.option("--eta", default=0.0,
+              help="Stochastic-DDIM noise scale for the draft2img restarts "
+                   "(0 = the reference's deterministic sampler).")
+def main(config_name, checkpoint, init_random, draft, interpolate, cold_n,
+         seed, eta):
     import jax
     import jax.numpy as jnp
 
@@ -114,8 +118,9 @@ def main(config_name, checkpoint, init_random, draft, interpolate, cold_n, seed)
         for i, t_start in enumerate(t_starts):
             noisy = sampling.forward_noise(
                 jax.random.PRNGKey(seed + 100 + i), x, t_start, model.total_steps)
-            variants.append(sampling.sample_from(model, params, noisy,
-                                                 t_start=t_start, k=10)[0])
+            variants.append(sampling.sample_from(
+                model, params, noisy, t_start=t_start, k=10, eta=eta,
+                rng=jax.random.PRNGKey(seed + 200 + i))[0])
         tiles = jnp.stack([(x[0] + 1.0) / 2.0] + variants)
         out = save_grid(tiles, get_next_path(os.path.join(saved, "draft2img.png")),
                         nrows=2, ncols=5)

@@ -163,14 +163,18 @@ def ddim_sample(
                            k=k, t_start=t_start, eta=eta)
 
 
-def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10) -> jax.Array:
+def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
+                eta: float = 0.0,
+                rng: Optional[jax.Array] = None) -> jax.Array:
     """Guided sampling: DDIM-denoise an encoded image from level ``t_start``.
 
     Strictly a prefix-truncated ``ddim_sample`` (SURVEY.md C24). The
     draft2drawing app composes this with ``forward_noise``; slerp interpolation
-    (C25) composes it with a spherical mix of two encodings.
+    (C25) composes it with a spherical mix of two encodings. ``eta`` > 0
+    switches to stochastic DDIM (see ``ddim_sample``) and requires ``rng``.
     """
-    return ddim_sample(model, params, x_init=x_init, t_start=t_start, k=k)
+    return ddim_sample(model, params, rng, x_init=x_init, t_start=t_start,
+                       k=k, eta=eta)
 
 
 def slerp(a: jax.Array, b: jax.Array, frac: jax.Array) -> jax.Array:
