@@ -256,7 +256,14 @@ def pil_loader(path: str) -> Image.Image:
         return img.convert("RGB")
 
 
-def _list_images(root: str) -> list[str]:
+def _list_images(root: str, hint_size: int = 64) -> list[str]:
+    if not os.path.isdir(root):
+        out = os.path.dirname(root) or root  # <set>/train → <set>
+        raise FileNotFoundError(
+            f"dataset folder {root!r} does not exist — point the yaml's "
+            "dataStorage at a folder of images, or generate the committed "
+            f"surrogate set: python scripts/make_dataset.py --out {out} "
+            f"--size {hint_size}")
     names = sorted(
         n for n in os.listdir(root) if os.path.splitext(n)[1].lower() in _IMG_EXTS
     )
@@ -298,7 +305,7 @@ class DiffusionDataset(_BaseCache):
         self.seed = seed
         self.use_native = use_native
         self.epoch = 0
-        self.imgList = _list_images(root)
+        self.imgList = _list_images(root, hint_size=int(self.img_size[0]))
         self._init_cache(cache_images, len(self.imgList), self.img_size)
 
     def set_epoch(self, epoch: int) -> None:
@@ -388,7 +395,7 @@ class ColdDownSampleDataset(_BaseCache):
         self.seed = seed
         self.use_native = use_native
         self.epoch = 0
-        self.imgList = _list_images(root)
+        self.imgList = _list_images(root, hint_size=int(self.img_size[0]))
         self._init_cache(cache_images, len(self.imgList), self.img_size)
 
     def set_epoch(self, epoch: int) -> None:
