@@ -196,6 +196,26 @@ def _background_map(items, fn, depth: int):
             thread.join(timeout=0.2)
 
 
+def group_batches(batches, n: int):
+    """Stack every ``n`` successive batches along a new leading axis — the
+    host-side half of ``make_train_step(steps_per_dispatch=n)``: one grouped
+    batch becomes one dispatch running n optimizer steps on device. A
+    trailing partial group (< n batches at epoch end) is dropped, mirroring
+    ``drop_last`` semantics — callers that must see every sample should size
+    epochs divisible by n or flush the tail with a 1-step fn."""
+    if n <= 1:
+        yield from batches
+        return
+    import jax
+
+    buf = []
+    for b in batches:
+        buf.append(b)
+        if len(buf) == n:
+            yield jax.tree.map(lambda *xs: np.stack(xs), *buf)
+            buf = []
+
+
 def device_prefetch(batches, place, depth: int = 2):
     """Yield ``place(batch)`` for each host batch, with the placement (the
     host→device copy) running ``depth`` batches ahead in a background thread.

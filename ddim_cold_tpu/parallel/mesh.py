@@ -57,20 +57,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
+def batch_sharding(mesh: Mesh, grouped: bool = False) -> NamedSharding:
     """Batch arrays shard their leading dim over 'data' (DistributedSampler's
     role, now expressed as a sharding annotation). Meshes without a 'data'
-    axis (e.g. pure sequence-parallel ``{seq: N}``) replicate the batch."""
-    return NamedSharding(mesh, P("data") if "data" in mesh.shape else P())
+    axis (e.g. pure sequence-parallel ``{seq: N}``) replicate the batch.
+
+    ``grouped``: the batch carries a leading steps-per-dispatch axis (see
+    train.step ``steps_per_dispatch``) — the scan axis stays unsharded and
+    'data' moves to the per-step batch dim behind it."""
+    if "data" not in mesh.shape:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(None, "data") if grouped else P("data"))
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, grouped: bool = False):
     """Place a host-local batch as a global array sharded on 'data'.
 
     Multi-host: each process contributes its shard of the global batch
     (``make_array_from_process_local_data`` — the SPMD replacement for
     DistributedSampler rank interleaving)."""
-    s = batch_sharding(mesh)
+    s = batch_sharding(mesh, grouped=grouped)
     if jax.process_count() > 1:
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(s, np.asarray(x)), batch

@@ -65,7 +65,8 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
                     prepare: Optional[Callable] = None,
                     ema_decay: float = 0.0,
                     grad_accum: int = 1,
-                    moe_aux_weight: float = 0.0) -> Callable:
+                    moe_aux_weight: float = 0.0,
+                    steps_per_dispatch: int = 1) -> Callable:
     """``(state, batch, rng, loss_rec) → (state, loss, loss_rec)``.
 
     The EMA train loss (0.99/0.01, multi_gpu_trainer.py:126) is carried as a
@@ -104,6 +105,19 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
     forward runs with the ``losses`` collection mutable and the Switch
     load-balance loss — the mean of the per-block ``sow``n values — is
     added to the smooth-L1 with this coefficient.
+
+    ``steps_per_dispatch`` > 1 changes the batch contract: every leaf gains
+    a leading axis of that length (n stacked per-step batches) and ONE
+    dispatch runs n full optimizer steps through a ``lax.scan``, returning
+    the mean loss over them. Each inner step is the identical single-step
+    math (the per-step rng/prepare folds key off ``state.step``, which
+    advances inside the scan), so the result matches n sequential calls that
+    pass the same ``rng``. This is the host-link lever: n× fewer
+    host↔device round trips and n× larger transfers — decisive when the
+    device is network-attached (remote-TPU tunnel, DCN-fed host), a regime
+    where per-dispatch RPC latency and small-payload bandwidth dominate the
+    step time (measured r03: e2e cold 613 img/s vs 4,089 synthetic at the
+    same batch — the gap is entirely the tunnel link, not compute).
     """
     moe_on = moe_aux_weight > 0 and getattr(model, "num_experts", 1) > 1
     if moe_on and apply_fn is not None:
@@ -116,10 +130,12 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
     if not 0.0 <= ema_decay < 1.0:  # same bound config.py enforces — direct
         raise ValueError(  # API callers must not bypass it (1.0 freezes the
             f"ema_decay must be in [0, 1), got {ema_decay!r}")  # shadow)
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
 
-    @partial(jax.jit, donate_argnums=(0, 3))
-    def train_step(state: EmaTrainState, batch, rng: jax.Array,
-                   loss_rec: jax.Array):
+    def step_body(state: EmaTrainState, batch, rng: jax.Array,
+                  loss_rec: jax.Array):
         if prepare is not None:
             # distinct fold constant: fold_in(rng, step+1) would be bit-equal
             # to the NEXT step's dropout key, correlating a stochastic
@@ -185,7 +201,23 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
                 step_size=1.0 - ema_decay))
         return new_state, loss, loss_rec * 0.99 + loss * 0.01
 
-    return train_step
+    if steps_per_dispatch == 1:
+        return partial(jax.jit, donate_argnums=(0, 3))(step_body)
+
+    @partial(jax.jit, donate_argnums=(0, 3))
+    def multi_step(state: EmaTrainState, stacked_batch, rng: jax.Array,
+                   loss_rec: jax.Array):
+        def scan_body(carry, bt):
+            st, rec = carry
+            st, loss, rec = step_body(st, bt, rng, rec)
+            return (st, rec), loss
+
+        (state, loss_rec), losses = jax.lax.scan(
+            scan_body, (state, loss_rec), stacked_batch,
+            length=steps_per_dispatch)
+        return state, losses.mean(), loss_rec
+
+    return multi_step
 
 
 def make_eval_step(model, apply_fn: Optional[Callable] = None,

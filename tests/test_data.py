@@ -280,6 +280,21 @@ def test_cache_matches_uncached(synthetic_image_dir):
                 np.testing.assert_array_equal(x, y)
 
 
+def test_group_batches_stacks_and_drops_tail():
+    """group_batches(n) stacks n batches on a new leading axis and drops a
+    short tail (drop_last semantics) — the host half of steps_per_dispatch."""
+    from ddim_cold_tpu.data.loader import group_batches
+
+    batches = [(np.full((2, 4), i, np.uint8), np.full((2,), i, np.int32))
+               for i in range(5)]
+    groups = list(group_batches(iter(batches), 2))
+    assert len(groups) == 2  # batch 4 is the dropped tail
+    assert groups[0][0].shape == (2, 2, 4) and groups[0][1].shape == (2, 2)
+    np.testing.assert_array_equal(groups[1][1], [[2, 2], [3, 3]])
+    # n=1 passes batches through untouched
+    assert list(group_batches(iter(batches), 1))[3][1][0] == 3
+
+
 def test_cache_auto_threshold(synthetic_image_dir):
     from ddim_cold_tpu.data import ColdDownSampleDataset
     from ddim_cold_tpu.data import datasets as dsmod

@@ -76,6 +76,35 @@ def test_dp_training_matches_single_device():
         s1.params, s2.params)
 
 
+def test_steps_per_dispatch_grouped_sharding_matches():
+    """spd>1 under a dp mesh: the grouped batch shards dim 1 on 'data'
+    (scan axis unsharded) and the scanned dispatch matches sequential
+    single-device steps on the same batches."""
+    model, state0, batch = _tiny_state()
+    rng = jax.random.PRNGKey(42)
+    one_step = make_train_step(model)
+    s1 = state0
+    rec1 = jnp.float32(5.0)
+    for _ in range(2):  # same batch twice: rng folds differ via state.step
+        s1, _, rec1 = one_step(s1, batch, rng, rec1)
+
+    model2, state2, _ = _tiny_state()
+    mesh = make_mesh({"data": 8, "model": 1})
+    state2 = shard_params(state2, mesh)
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x]), batch)
+    grouped = shard_batch(stacked, mesh, grouped=True)
+    # leading (scan) axis replicated, batch dim sharded over 'data'
+    assert grouped[0].sharding.spec == jax.sharding.PartitionSpec(None, "data")
+    multi = make_train_step(model2, steps_per_dispatch=2)
+    s2, _, rec2 = multi(state2, grouped, rng, jnp.float32(5.0))
+
+    np.testing.assert_allclose(float(rec1), float(rec2), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+        s1.params, s2.params)
+
+
 def test_tp_forward_matches_replicated():
     """Megatron-style tensor sharding is output-invariant."""
     model, state, batch = _tiny_state()
