@@ -55,7 +55,8 @@ def img2tensor(path: str, img_size):
 @click.option("--seed", default=0, help="Sampling rng seed.")
 @click.option("--eta", default=0.0,
               help="Stochastic-DDIM noise scale for the draft2img restarts "
-                   "(0 = the reference's deterministic sampler).")
+                   "and the --interpolate decode (0 = the reference's "
+                   "deterministic sampler).")
 def main(config_name, checkpoint, init_random, draft, interpolate, cold_n,
          seed, eta):
     import jax
@@ -64,9 +65,10 @@ def main(config_name, checkpoint, init_random, draft, interpolate, cold_n,
     from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
     from ddim_cold_tpu.ops import sampling
     from ddim_cold_tpu.utils import checkpoint as ckpt
-    from ddim_cold_tpu.utils.platform import honor_env_platform
+    from ddim_cold_tpu.utils.platform import enable_compile_cache, honor_env_platform
 
     honor_env_platform()
+    enable_compile_cache()  # repeat CLI runs reuse compiled XLA programs
     from ddim_cold_tpu.utils.image import get_next_path, grid_shape, save_grid
 
     model = DiffusionViT(total_steps=2000, **MODEL_CONFIGS[config_name])
@@ -132,7 +134,7 @@ def main(config_name, checkpoint, init_random, draft, interpolate, cold_n,
         b = img2tensor(interpolate[1], model.img_size)[0]
         frames = sampling.slerp_interpolate(
             model, params, jax.random.PRNGKey(seed + 500), a, b,
-            n_interp=8, t_start=1800, k=10)
+            n_interp=8, t_start=1800, k=10, eta=eta)
         out = save_grid(frames, get_next_path(os.path.join(saved, "interpolation.png")),
                         nrows=1, ncols=8)
         print(f"wrote {out}")

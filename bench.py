@@ -39,6 +39,40 @@ BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
 _E2E_TMP = {"path": None}
 
 
+def _reuse_round_record(reason, root=None):
+    """When the live probe says the tunnel is wedged, fall back to THIS
+    round's committed TPU record instead of a meaningless CPU smoke.
+
+    Two rounds running, the driver's end-of-round bench landed during a
+    tunnel outage and the official BENCH_r0{2,3}.json recorded an 8.9 img/s
+    CPU fallback while the real hardware record sat in results/ (VERDICT r3
+    item 2). The recovery chain writes ``results/bench_r{N}_tpu.json`` the
+    moment the tunnel returns mid-round; the current round N is inferred
+    from the committed ``BENCH_r*.json`` files (the driver writes r{N} AFTER
+    this bench runs, so N = max existing + 1). The reused record is labeled
+    ``captured_earlier`` with the live-probe failure, never silently."""
+    import glob
+    import re
+
+    from ddim_cold_tpu.utils.record import is_tpu_record, last_json_record
+
+    here = root or os.path.dirname(os.path.abspath(__file__))
+    rounds = [int(m.group(1)) for f in glob.glob(os.path.join(here, "BENCH_r*.json"))
+              for m in [re.search(r"BENCH_r(\d+)\.json$", os.path.basename(f))] if m]
+    rnd = (max(rounds) + 1) if rounds else 1
+    # preference order: the full bench record, then the chain's partial legs
+    for name in (f"bench_r{rnd:02d}_tpu.json", f"bench_r{rnd:02d}_tpu_full.json",
+                 f"bench_r{rnd:02d}_northstar.json"):
+        path = os.path.join(here, "results", name)
+        rec = last_json_record(path)
+        if is_tpu_record(rec) and rec.get("value") is not None:
+            rec["captured_earlier"] = True
+            rec.setdefault("submetrics", {})["captured_earlier"] = {
+                "file": os.path.relpath(path, here), "live_probe": reason}
+            return rec
+    return None
+
+
 def main(argv=None):
     """``argv=None`` → sys.argv; scripts (tpu_validate) pass a list to reuse
     this harness as the single source of timing truth."""
@@ -78,6 +112,10 @@ def main(argv=None):
         # and one bad probe must not cost the round's whole hardware record
         plat, reason = ensure_live_backend(attempts=3)
         if plat == "cpu":
+            reused = _reuse_round_record(reason)
+            if reused is not None:
+                print(json.dumps(reused))
+                return
             # wedged/unreachable TPU tunnel: a CPU-labelled record beats a
             # bench that hangs forever and records nothing. Downscope to a
             # smoke run (one shared mechanism, resolved below — explicit
@@ -89,6 +127,10 @@ def main(argv=None):
             args.skip_sampler = True
             print(f"[bench] WARNING: {reason} — falling back to a CPU smoke "
                   "run; real-hardware sections dropped", file=sys.stderr)
+    from ddim_cold_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()  # repeat compiles (chain re-runs, driver re-runs)
+    # become disk reads; first-ever compiles are unaffected
     import jax.numpy as jnp
     import numpy as np
 
@@ -130,8 +172,13 @@ def main(argv=None):
     }
     progress = {"t": time.time(), "label": "backend init", "done": False}
 
-    def mark(label):
+    def mark(label, budget_s=None):
+        """Liveness beacon. ``budget_s`` stretches the watchdog deadline for
+        the window AFTER this mark — known-long silent operations (a first
+        XLA/Mosaic compile of the 200px model can legitimately exceed the
+        default stall budget) must not be killed as wedged (ADVICE r3)."""
         progress["t"], progress["label"] = time.time(), label
+        progress["budget"] = budget_s
 
     # Default: armed only when an accelerator platform is CONFIGURED — read
     # from jax.config, not a backend query: the watchdog must be running
@@ -157,7 +204,8 @@ def main(argv=None):
         while not (progress["done"] or progress.get("disarmed")):
             time.sleep(min(15.0, max(0.2, stall_s / 4)))  # outlive main()
             idle = time.time() - progress["t"]
-            if progress["done"] or idle <= stall_s:
+            limit = max(stall_s, progress.get("budget") or 0.0)
+            if progress["done"] or idle <= limit:
                 continue
             try:
                 # snapshot: the main thread may mutate sub mid-serialization
@@ -246,7 +294,8 @@ def main(argv=None):
             — a real D2H transfer — because block_until_ready can return early
             through the remote-TPU tunnel, silently timing only the dispatch."""
             step = step or train_step
-            mark(f"train-step compile b{bt[0].shape[0]}")  # pre-compile beacon:
+            mark(f"train-step compile b{bt[0].shape[0]}",  # pre-compile beacon:
+                 budget_s=2 * stall_s)  # compiles are silent AND can be long
             ema = jnp.float32(5.0)  # the compile itself emits no progress
             t0 = time.time()
             st, _, ema = step(st, bt, jax.random.PRNGKey(1), ema)
@@ -308,7 +357,10 @@ def main(argv=None):
         scaling_rows = {}  # per-batch memo: a section retry redoes only the tail
 
         def run_scaling():
-            for b in (64, 128, 256):
+            # through b1024 (VERDICT r3 item 4: find where the MFU curve
+            # flattens — 7.2M params + Adam state is HBM-trivial, activations
+            # at b1024/65 tokens are ~1.3 GB in bf16, well inside a v5e)
+            for b in (64, 128, 256, 512, 1024):
                 if b in scaling_rows:
                     continue
                 bt = synth_batch(b)
@@ -331,29 +383,42 @@ def main(argv=None):
         if not args.skip_scaling:
             section("batch_scaling", run_scaling)
 
-        # ----------------------------------------------------------- scan_blocks
-        def run_scan_blocks():
-            # measured basis for the PERF.md compile-vs-step decision: the same
-            # headline step with depth under nn.scan (stacked params, one
-            # compiled block body) vs the unrolled headline above
-            sc_model = DiffusionViT(dtype=jnp.bfloat16, scan_blocks=True,
-                                    **MODEL_CONFIGS["vit_tiny"])
-            st = create_train_state(sc_model, jax.random.PRNGKey(0), lr=2e-4,
-                                    total_steps=51200, sample_batch=batch)
-            _, sp, comp = time_train(st, batch, max(10, args.steps // 2),
-                                     step=make_train_step(sc_model))
-            sub["scan_blocks"] = {
-                "batch": B,
+        # ------------------------------------------- depth-layout rows (big batch)
+        def run_layout_row(name, **model_kwargs):
+            # measured basis for the PERF.md compile-vs-step decision, taken
+            # at the LARGEST batch the scaling sweep completed (VERDICT r3
+            # item 4: the interesting regime is where MFU flattens, not b32):
+            # scan_blocks = depth under nn.scan (stacked params, one compiled
+            # block body); remat = jax.checkpoint each block (recompute
+            # activations in backward — the HBM-for-FLOPs trade)
+            big = max(scaling_rows) if scaling_rows else B
+            bt = batch if big == B else synth_batch(big)
+            lm = DiffusionViT(dtype=jnp.bfloat16, **model_kwargs,
+                              **MODEL_CONFIGS["vit_tiny"])
+            st = create_train_state(lm, jax.random.PRNGKey(0), lr=2e-4,
+                                    total_steps=51200, sample_batch=bt)
+            _, sp, comp = time_train(st, bt, max(10, args.steps // 2),
+                                     step=make_train_step(lm))
+            fl = flops_util.train_step_flops(big, mlp_ratio=1.0,
+                                             **MODEL_CONFIGS["vit_tiny"])
+            m = flops_util.mfu(fl, sp, chip)
+            plain = scaling_rows.get(big)
+            plain_ms = plain["ms_per_step"] if plain else round(1000 * spi, 3)
+            sub[name] = {
+                "batch": big,
                 "ms_per_step": round(1000 * sp, 3),
-                "img_per_sec": round(B / sp, 1),
+                "img_per_sec": round(big / sp, 1),
+                "mfu": None if m is None else round(m, 4),
                 "compile_s": round(comp, 1),
-                "unrolled_ms_per_step": round(1000 * spi, 3),
-                "unrolled_compile_s": round(compile_s, 1)}
-            log(f"scan_blocks b{B}: {1000*sp:.2f} ms/step (compile {comp:.1f}s) "
-                f"vs unrolled {1000*spi:.2f} ms/step (compile {compile_s:.1f}s)")
+                "plain_ms_per_step": plain_ms,
+                "plain_compile_s": round(compile_s, 1)}
+            log(f"{name} b{big}: {1000*sp:.2f} ms/step (compile {comp:.1f}s) "
+                f"vs plain {plain_ms} ms/step")
 
-        if not args.skip_scaling:  # --skip-scaling drops both depth-layout rows
-            section("scan_blocks", run_scan_blocks)
+        if not args.skip_scaling:  # --skip-scaling drops the depth-layout rows
+            section("scan_blocks",
+                    lambda: run_layout_row("scan_blocks", scan_blocks=True))
+            section("remat", lambda: run_layout_row("remat", remat=True))
 
         # ------------------------------------------------------------- samplers
         def time_ddim(smodel, sparams, k, n, label):
@@ -367,7 +432,9 @@ def main(argv=None):
             # can never alias a different config onto a stale timing
             key = (smodel, k, n)
             if key not in timed:
-                mark(f"sampler compile {label} k={k} n={n}")
+                # the 200px flash kernel's first Mosaic compile is the
+                # longest silent window in the whole bench — give it slack
+                mark(f"sampler compile {label} k={k} n={n}", budget_s=2 * stall_s)
                 img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(2), k=k, n=n)
                 np.asarray(img)
                 best = float("inf")
@@ -492,21 +559,44 @@ def _bench_e2e(args, model, state, log):
         mk.write_split(tmp, "train", n_imgs, 64, 20220822)
         root = os.path.join(tmp, "train")
     try:
-        from ddim_cold_tpu.data.loader import device_prefetch
+        from ddim_cold_tpu.data.loader import device_prefetch, group_batches
         from ddim_cold_tpu.ops import degrade
         from ddim_cold_tpu.train.step import make_train_step
 
+        import numpy as _np
+
+        out = {}
+        # link diagnostic first: raw H2D bandwidth on a 4 MB payload. r03's
+        # e2e gap (cold 613 img/s vs 4,089 synthetic at the same batch) is
+        # the NETWORK-ATTACHED device link, not loader or compute — the
+        # loader alone moves >10k img/s cold on this host. Recording the
+        # link speed makes the e2e rows interpretable on any topology.
+        payload = _np.zeros((4 << 20,), _np.uint8)
+        bw = 0.0
+        for _ in range(2):  # keep the faster rep (TCP slow-start warms)
+            t0 = time.time()
+            dev = jnp.asarray(payload)
+            float(dev[0])  # real sync — block_until_ready can return early
+            bw = max(bw, len(payload) / (1 << 20) / (time.time() - t0))
+        out["h2d_bandwidth_mib_s"] = round(bw, 1)
+        log(f"e2e: H2D link ≈ {bw:.0f} MiB/s")
+
         ds = ColdDownSampleDataset(root, imgSize=(64, 64), target_mode="chain")
         # the trainer's shipped data path: raw (base, t) batches, corruption
-        # in-jit on device, H2D overlapped with compute (train/trainer.py)
+        # in-jit on device, H2D overlapped with compute (train/trainer.py).
+        # On a network-attached device, group steps_per_dispatch batches into
+        # one transfer + one dispatch (lax.scan over the group): n× fewer
+        # round trips and n× larger payloads — the two levers a thin host
+        # link responds to. Local backends keep spd=1 (nothing to amortize).
+        spd = 1 if jax.default_backend() == "cpu" else 8
         loader = ShardedLoader(ds, args.batch, shuffle=True, seed=42,
                                drop_last=True, raw=True)
         raw_step = make_train_step(
             model,
             prepare=degrade.make_cold_prepare(size=64, max_step=ds.max_step,
                                               chain=True),
+            steps_per_dispatch=spd,
         )
-        out = {}
         place = lambda b: jax.tree.map(jnp.asarray, b)  # noqa: E731
         # compile outside the timed loops with a synthetic batch matching the
         # dataset's ACTUAL ship dtype — uint8 when the loader ships raw bytes
@@ -514,38 +604,39 @@ def _bench_e2e(args, model, state, log):
         # loader would leave the first timed "cold" step paying a full jit
         # retrace under the new dtype signature, exactly what this warmup
         # exists to exclude (ADVICE r2 medium).
-        import numpy as _np
-
         _r = _np.random.RandomState(7)
         log("e2e: warmup compile")  # liveness beacon before the silent compile
+        shape = (spd, args.batch) if spd > 1 else (args.batch,)
         if getattr(ds, "_uniform_u8", False):
             bases = _np.asarray(
-                _r.randint(0, 256, size=(args.batch, 64, 64, 3)), _np.uint8)
+                _r.randint(0, 256, size=shape + (64, 64, 3)), _np.uint8)
         else:
-            bases = _np.asarray(
-                _r.randn(args.batch, 64, 64, 3), _np.float32)
+            bases = _np.asarray(_r.randn(*shape, 64, 64, 3), _np.float32)
         state, _, _ = raw_step(
             state,
             (jnp.asarray(bases),
-             jnp.asarray(_r.randint(1, 7, size=(args.batch,)), jnp.int32)),
+             jnp.asarray(_r.randint(1, 7, size=shape), jnp.int32)),
             jax.random.PRNGKey(0), jnp.float32(5.0))
         for label in ("cold", "warm"):
             log(f"e2e: {label} epoch start")  # liveness beacon
             loader.set_epoch(0)
             ema = jnp.float32(5.0)
             t0, nb = time.time(), 0
-            for b in device_prefetch(loader, place):
+            for b in device_prefetch(group_batches(loader, spd), place,
+                                     depth=4):
                 state, _, ema = raw_step(state, b, jax.random.PRNGKey(1), ema)
-                nb += 1
+                nb += spd
                 if nb * args.batch >= n_imgs:
                     break
             float(ema)
             dt = time.time() - t0
             ips = nb * args.batch / dt
             log(f"e2e {label} epoch: {nb} steps in {dt:.2f}s → {ips:.0f} img/s "
-                "(disk → decode → base → device → degrade-in-jit → step)")
+                "(disk → decode → base → device → degrade-in-jit → step, "
+                f"{spd} steps/dispatch)")
             out[f"e2e_train_throughput_{label}"] = {
                 "value": round(ips, 1), "unit": "img/s",
+                "steps_per_dispatch": spd,
                 "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3)}
         return out
     finally:

@@ -1,0 +1,35 @@
+"""Bench-record parsing shared by bench.py's captured-earlier fallback and
+the recovery chain's idempotence oracle (scripts/r04_stage_done.py) — ONE
+policy for "what is the record in this file" and "was it captured on a real
+accelerator", so the chain and the bench can never disagree about whether a
+committed results file is a reusable TPU record."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def last_json_record(path: str) -> Optional[dict]:
+    """Last parseable JSON line of ``path`` — a fatal/watchdog emit can
+    print the record twice, and the last one is the most complete. None when
+    the file is missing/empty/garbage."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        return rec if isinstance(rec, dict) else None
+    return None
+
+
+def is_tpu_record(rec) -> bool:
+    """True when ``rec`` is a bench record captured on a real accelerator —
+    chip recorded and not a CPU fallback."""
+    return bool(isinstance(rec, dict) and rec.get("chip")
+                and "cpu" not in str(rec["chip"]).lower())

@@ -49,9 +49,10 @@ def main(argv=None):
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
 
-    from ddim_cold_tpu.utils.platform import honor_env_platform
+    from ddim_cold_tpu.utils.platform import enable_compile_cache, honor_env_platform
 
     honor_env_platform()
+    enable_compile_cache()  # repeat CLI runs reuse compiled XLA programs
     import jax
 
     if args.cpu:
