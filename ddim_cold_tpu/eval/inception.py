@@ -254,10 +254,42 @@ def flax_from_torch_inception(state_dict: dict) -> dict:
 def load_torch_inception(path: str):
     """Load a torchvision inception_v3 ``.pth`` checkpoint → (model, variables).
     torch is a conversion-time-only dependency (same policy as
-    utils/checkpoint.py)."""
+    utils/checkpoint.py).
+
+    The converted tree is VERIFIED against the model's own init template —
+    every param/stat path must exist with the right shape, both directions —
+    before it is returned: a truncated or wrong-architecture file (e.g. a
+    classifier-only checkpoint) fails here with the offending path named,
+    not deep inside the first FID batch. The numerics of the layout
+    transform itself are pinned against a real torch forward in
+    tests/test_inception_parity.py."""
+    import jax
     import torch
 
     sd = torch.load(path, map_location="cpu", weights_only=False)
     if not isinstance(sd, dict):
         sd = sd.state_dict()
-    return InceptionV3Features(), flax_from_torch_inception(sd)
+    variables = flax_from_torch_inception(sd)
+    # shapes only — eval_shape traces the init abstractly (no compile, no
+    # FLOPs), where a real init_variables() would pay the full 94-conv init
+    template = jax.eval_shape(
+        InceptionV3Features().init, jax.random.PRNGKey(0),
+        jnp.zeros((1, INCEPTION_SIZE, INCEPTION_SIZE, 3)))
+    want = {p: v.shape for p, v in
+            jax.tree_util.tree_leaves_with_path(template)}
+    got = {p: v.shape for p, v in
+           jax.tree_util.tree_leaves_with_path(variables)}
+    for p, shape in want.items():
+        name = jax.tree_util.keystr(p)
+        if p not in got:
+            raise ValueError(
+                f"{path}: converted checkpoint is missing {name} — not a "
+                "full torchvision/pytorch-fid inception_v3 state_dict?")
+        if tuple(got[p]) != tuple(shape):
+            raise ValueError(
+                f"{path}: {name} has shape {tuple(got[p])}, expected "
+                f"{tuple(shape)}")
+    extra = [jax.tree_util.keystr(p) for p in got if p not in want]
+    if extra:
+        raise ValueError(f"{path}: unexpected converted keys {extra[:5]}")
+    return InceptionV3Features(), variables

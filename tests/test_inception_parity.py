@@ -236,6 +236,37 @@ def test_feature_parity_torch_vs_flax():
     assert (num / den > 0.9999).all()
 
 
+def test_load_torch_inception_pth_end_to_end(tmp_path):
+    """The documented canonical-weights path: `torch.save` a full
+    torchvision-schema state_dict to disk, load via `load_torch_inception`
+    (the --inception-pth code path), and get a verified, working extractor.
+    A truncated file must fail the structural verification loudly, naming
+    the missing path — not crash deep inside the first FID batch."""
+    import jax.numpy as jnp
+
+    from ddim_cold_tpu.eval.inception import (
+        FEATURE_DIM, load_torch_inception,
+    )
+
+    m = _randomized(3)
+    pth = str(tmp_path / "inception_v3.pth")
+    torch.save(m.state_dict(), pth)
+    model, variables = load_torch_inception(pth)
+    x = jnp.zeros((1, 299, 299, 3))
+    feats = model.apply(variables, x)
+    assert feats.shape == (1, FEATURE_DIM)
+    assert bool(jnp.isfinite(feats).all())
+
+    sd = m.state_dict()
+    dropped = next(k for k in sd if k.startswith("Mixed_7c"))
+    sd = {k: v for k, v in sd.items() if not k.startswith("Mixed_7c")}
+    bad = str(tmp_path / "truncated.pth")
+    torch.save(sd, bad)
+    with pytest.raises(ValueError, match="Mixed_7c"):
+        load_torch_inception(bad)
+    assert dropped  # (sanity: the truncation removed something real)
+
+
 def test_stem_tap_parity():
     """First-conv tap in isolation: catches layout-transform errors directly
     at the input boundary (stride-2 VALID conv + BN eval)."""
