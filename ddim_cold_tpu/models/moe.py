@@ -22,6 +22,15 @@ Design notes (TPU-first):
   collection; the train step adds ``moe_aux_weight ×`` its mean (it is a
   no-op for consumers that do not mark the collection mutable, so the
   sampler/eval paths need no changes).
+
+When to use: the one-hot dispatch/combine tensors are (B, N, E, C) floats
+with E·C ≈ N·capacity_factor, i.e. **O(B·N²·cf) activation memory per MoE
+block** — negligible at the 64px scales this ships tested at (N ≤ 257), but
+at the 200px/p4 config (N = 2501) the dispatch tensors alone would be
+~25 MB·B·cf per block in bf16 and dominate HBM long before the expert
+banks do (ADVICE r3). Pairing MoE with long-sequence configs needs an
+index-based (argsort/segment-sum) dispatch first — prefer dense MLP + the
+``seq`` axis there until then.
 """
 
 from __future__ import annotations

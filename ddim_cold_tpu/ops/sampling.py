@@ -214,17 +214,21 @@ def slerp_interpolate(
     n_interp: int = 8,
     t_start: int = 1800,
     k: int = 10,
+    eta: float = 0.0,
 ) -> jax.Array:
     """End-to-end latent interpolation (C25): encode both images to ``t_start``
     (one rng key, independent noise per endpoint — matching the reference's two
     separate draws, ViT_draft2drawing.py:442-443), slerp ``n_interp`` fractions
     between the encodings, and DDIM-decode each — returns (n_interp, H, W, C)
-    in [0, 1]."""
+    in [0, 1]. ``eta`` > 0 decodes stochastically (same semantics as
+    :func:`sample_from`; the decode key is folded from ``rng`` so the
+    encoding noise and the decode noise stay independent)."""
     batch = jnp.stack([img_a, img_b])
     noisy = forward_noise(rng, batch, t_start, model.total_steps)
     frac = jnp.linspace(0.0, 1.0, n_interp).reshape(-1, 1, 1, 1, 1)
     mixed = slerp(noisy[0][None], noisy[1][None], frac)[:, 0]
-    return sample_from(model, params, mixed, t_start=t_start, k=k)
+    return sample_from(model, params, mixed, t_start=t_start, k=k, eta=eta,
+                       rng=jax.random.fold_in(rng, 1))
 
 
 @partial(jax.jit, static_argnames=("model", "levels", "return_sequence"))
