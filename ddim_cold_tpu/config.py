@@ -114,6 +114,10 @@ class ExperimentConfig:
     num_experts: int = 1
     moe_capacity_factor: float = 1.25  # per-expert queue: ceil(N·cf/E)
     moe_aux_weight: float = 0.01  # Switch load-balance loss coefficient
+    # routing implementation (models/moe.py): "einsum" = one-hot GEMM
+    # dispatch (XLA-friendliest, O(N²·cf) activations); "index" =
+    # sort/gather dispatch (O(N·cf·D)) for long-sequence configs
+    moe_dispatch: str = "einsum"
 
     @property
     def effective_batch(self) -> int:
@@ -161,6 +165,7 @@ class ExperimentConfig:
             scan_blocks=self.scan_blocks,
             num_experts=self.num_experts,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_dispatch=self.moe_dispatch,
         )
 
 
@@ -193,6 +198,13 @@ def _check_moe_capacity(value: float) -> float:
 def _check_moe_aux(value: float) -> float:
     if value < 0.0:  # negative would actively REWARD routing imbalance
         raise ValueError(f"moe_aux_weight must be >= 0, got {value!r}")
+    return value
+
+
+def _check_moe_dispatch(value: str) -> str:
+    if value not in ("einsum", "index"):
+        raise ValueError(
+            f"moe_dispatch must be 'einsum' or 'index', got {value!r}")
     return value
 
 
@@ -254,6 +266,7 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         moe_capacity_factor=_check_moe_capacity(
             float(raw.get("moe_capacity_factor", 1.25))),
         moe_aux_weight=_check_moe_aux(float(raw.get("moe_aux_weight", 0.01))),
+        moe_dispatch=_check_moe_dispatch(raw.get("moe_dispatch", "einsum")),
         grad_accum=_check_grad_accum(int(raw.get("grad_accum", 1))),
         steps_per_dispatch=_check_steps_per_dispatch(
             int(raw.get("steps_per_dispatch", 1))),

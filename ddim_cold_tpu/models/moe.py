@@ -23,14 +23,20 @@ Design notes (TPU-first):
   no-op for consumers that do not mark the collection mutable, so the
   sampler/eval paths need no changes).
 
-When to use: the one-hot dispatch/combine tensors are (B, N, E, C) floats
-with E·C ≈ N·capacity_factor, i.e. **O(B·N²·cf) activation memory per MoE
-block** — negligible at the 64px scales this ships tested at (N ≤ 257), but
-at the 200px/p4 config (N = 2501) the dispatch tensors alone would be
-~25 MB·B·cf per block in bf16 and dominate HBM long before the expert
-banks do (ADVICE r3). Pairing MoE with long-sequence configs needs an
-index-based (argsort/segment-sum) dispatch first — prefer dense MLP + the
-``seq`` axis there until then.
+Two dispatch implementations, selectable per config (``moe_dispatch``):
+
+* ``"einsum"`` (default) — one-hot dispatch/combine tensors (B, N, E, C)
+  with E·C ≈ N·capacity_factor, i.e. **O(B·N²·cf) activation memory per
+  MoE block**: all-GEMM, no gather/scatter, the friendliest form for the
+  XLA partitioner — and fine at the 64px scales (N ≤ 257);
+* ``"index"`` — stable-sort tokens by expert id, gather each expert's
+  capacity slice, scatter-free token-side combine via a per-token slot
+  gather: **O(B·N·cf·D)** activations, no quadratic tensor anywhere. The
+  stable sort preserves token order within an expert, so exactly the same
+  tokens overflow as under the einsum path's cumsum priority — the two
+  modes are numerically interchangeable (tested) — making MoE composable
+  with long-sequence configs (the 200px/p4 N=2501 case that motivated it,
+  ADVICE r3).
 """
 
 from __future__ import annotations
@@ -55,6 +61,7 @@ class SwitchMlp(nn.Module):
     capacity_factor: float = 1.25
     drop: float = 0.0
     dtype: Dtype = jnp.float32
+    dispatch: str = "einsum"  # "einsum" (one-hot GEMMs) | "index" (sort/gather)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -73,15 +80,40 @@ class SwitchMlp(nn.Module):
         gate = jnp.max(probs, axis=-1)  # (B, N)
 
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (B, N, E)
-        # position of each token in its expert's queue (per batch row)
-        pos = jnp.cumsum(onehot, axis=1) - onehot  # (B, N, E)
-        within = pos < C
-        keep = onehot * within  # (B, N, E) — dropped tokens zero out here
-        slot = jax.nn.one_hot(
-            (pos * onehot).sum(-1).astype(jnp.int32), C, dtype=jnp.float32)
-        # dispatch/combine one-hots (B, N, E, C): static-shape einsum routing
-        dispatch = keep[..., None] * slot[:, :, None, :]
-        combine = dispatch * gate[..., None, None]
+        if self.dispatch == "index":
+            # sort/gather routing, O(B·N·cf·D): stable sort by expert id
+            # groups tokens per expert WITHOUT changing their order inside a
+            # group, so slot priority (and therefore the overflow set) is
+            # identical to the einsum path's cumsum priority.
+            perm = jnp.argsort(expert, axis=1, stable=True)          # (B, N)
+            exp_sorted = jnp.take_along_axis(expert, perm, axis=1)   # (B, N)
+            x_sorted = jnp.take_along_axis(
+                x.astype(self.dtype), perm[..., None], axis=1)       # (B, N, D)
+            counts = jnp.sum(onehot, axis=1).astype(jnp.int32)       # (B, E)
+            starts = jnp.cumsum(counts, axis=1) - counts             # (B, E)
+            # expert e's queue slot c holds sorted token starts[e] + c
+            c_ar = jnp.arange(C, dtype=jnp.int32)
+            idx = starts[:, :, None] + c_ar[None, None, :]           # (B, E, C)
+            q_valid = c_ar[None, None, :] < counts[:, :, None]       # (B, E, C)
+            idx = jnp.clip(idx, 0, N - 1).reshape(B, E * C)
+            xe = jnp.take_along_axis(x_sorted, idx[..., None], axis=1)
+            xe = (xe.reshape(B, E, C, D)
+                  * q_valid[..., None].astype(self.dtype))
+        elif self.dispatch == "einsum":
+            # position of each token in its expert's queue (per batch row)
+            pos = jnp.cumsum(onehot, axis=1) - onehot  # (B, N, E)
+            within = pos < C
+            keep = onehot * within  # (B, N, E) — dropped tokens zero out here
+            slot = jax.nn.one_hot(
+                (pos * onehot).sum(-1).astype(jnp.int32), C, dtype=jnp.float32)
+            # dispatch/combine one-hots (B, N, E, C): static-shape einsum routing
+            dispatch = keep[..., None] * slot[:, :, None, :]
+            combine = dispatch * gate[..., None, None]
+            xe = jnp.einsum("bnd,bnec->becd", x.astype(self.dtype),
+                            dispatch.astype(self.dtype))
+        else:
+            raise ValueError(
+                f"dispatch must be 'einsum' or 'index', got {self.dispatch!r}")
 
         # ---- experts: stacked params, leading E shards over 'expert' -----
         O = self.out_features
@@ -90,15 +122,31 @@ class SwitchMlp(nn.Module):
         w2 = self.param("w2", trunc_normal(std=0.02), (E, H, O), jnp.float32)
         b2 = self.param("b2", nn.initializers.zeros_init(), (E, O), jnp.float32)
 
-        xe = jnp.einsum("bnd,bnec->becd", x.astype(self.dtype),
-                        dispatch.astype(self.dtype))
         h = jnp.einsum("becd,edh->bech", xe, w1.astype(self.dtype))
         h = h + b1.astype(self.dtype)[None, :, None, :]
         h = nn.gelu(h, approximate=False)
         h = nn.Dropout(self.drop, deterministic=deterministic)(h)
         ye = jnp.einsum("bech,ehd->becd", h, w2.astype(self.dtype))
         ye = ye + b2.astype(self.dtype)[None, :, None, :]
-        y = jnp.einsum("becd,bnec->bnd", ye, combine.astype(self.dtype))
+        if self.dispatch == "index":
+            # token-side combine: each token reads its own queue slot (a
+            # gather, no (B, N, E, C) combine tensor). pos = this token's
+            # rank within its expert group, recovered by inverting the sort.
+            rank = (jnp.arange(N, dtype=jnp.int32)[None, :]
+                    - jnp.take_along_axis(starts, exp_sorted, axis=1))
+            # invert the sort by scattering rank back to token order — O(N),
+            # where a second argsort would be another full TPU sort
+            tok_pos = jnp.put_along_axis(jnp.zeros_like(rank), perm, rank,
+                                         axis=1, inplace=False)      # (B, N)
+            keep_tok = tok_pos < C
+            slot_tok = jnp.clip(expert.astype(jnp.int32) * C + tok_pos,
+                                0, E * C - 1)
+            y = jnp.take_along_axis(ye.reshape(B, E * C, O),
+                                    slot_tok[..., None], axis=1)
+            w_tok = (gate * keep_tok).astype(self.dtype)
+            y = y * w_tok[..., None]
+        else:
+            y = jnp.einsum("becd,bnec->bnd", ye, combine.astype(self.dtype))
         y = nn.Dropout(self.drop, deterministic=deterministic)(y)
 
         # ---- Switch load-balance loss: E · Σ_e f_e · P_e -----------------

@@ -232,6 +232,7 @@ class Block(nn.Module):
     sp_mode: str = "ring"
     num_experts: int = 1  # >1: Switch-MoE MLP (models/moe.py, 'expert' axis)
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "einsum"  # routing impl: "einsum" | "index" (moe.py)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -286,6 +287,7 @@ class Block(nn.Module):
                 capacity_factor=self.moe_capacity_factor,
                 drop=self.drop,
                 dtype=self.dtype,
+                dispatch=self.moe_dispatch,
                 name="moe",
             )
         else:
@@ -408,6 +410,8 @@ class DiffusionViT(nn.Module):
     # expert params shard over an 'expert' mesh axis. Not composable with
     # scan_blocks/pipe (sow under nn.scan; the aux loss would be lost).
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "einsum"  # see models/moe.py: "index" removes the
+    # O(N^2*cf) one-hot dispatch tensors (long-sequence configs)
 
     @property
     def num_patches(self) -> int:
@@ -536,6 +540,7 @@ class DiffusionViT(nn.Module):
                     sp_mode=self.sp_mode,
                     num_experts=self.num_experts,
                     moe_capacity_factor=self.moe_capacity_factor,
+                    moe_dispatch=self.moe_dispatch,
                 )
                 probe = (return_attention_layer is not None
                          and i == return_attention_layer % self.depth)

@@ -94,9 +94,11 @@ def test_vit_with_experts_trains_and_routes_grads():
     assert delta.max() > 0
 
 
-def test_expert_sharded_step_matches_single_device():
+@pytest.mark.parametrize("dispatch", ["einsum", "index"])
+def test_expert_sharded_step_matches_single_device(dispatch):
     """dp×ep mesh: expert banks shard over 'expert', the step reproduces the
-    unsharded result (routing einsums are layout-independent under GSPMD)."""
+    unsharded result — for BOTH routing implementations (the einsums are
+    layout-independent under GSPMD; the index path's gathers must be too)."""
     from ddim_cold_tpu.parallel import make_mesh, shard_batch, shard_train_state
     from ddim_cold_tpu.parallel.sharding import param_partition_specs
     from ddim_cold_tpu.train.step import create_train_state, make_train_step
@@ -106,6 +108,7 @@ def test_expert_sharded_step_matches_single_device():
         model = DiffusionViT(img_size=(16, 16), patch_size=4, embed_dim=16,
                              depth=1, num_heads=2, total_steps=8,
                              num_experts=4, drop_rate=0.0,
+                             moe_dispatch=dispatch,
                              attn_drop_rate=0.0, drop_path_rate=0.0)
         rng = np.random.RandomState(0)
         batch = (jnp.asarray(rng.randn(4, 16, 16, 3), jnp.float32),
@@ -223,3 +226,79 @@ def test_moe_config_knobs_validated(tmp_path, synthetic_image_dir):
     with pytest.raises(ValueError, match="moe_aux_weight"):
         load_config(_write_config(str(tmp_path), synthetic_image_dir,
                                   moe_aux_weight=-0.1), "exp")
+
+
+def test_index_dispatch_matches_einsum():
+    """The sort/gather dispatch is numerically interchangeable with the
+    one-hot einsum dispatch — same params, same inputs, same outputs, same
+    aux loss — including under tight capacity where overflow happens (the
+    stable sort must drop exactly the cumsum-priority overflow set)."""
+    key = jax.random.PRNGKey(3)
+    for cf in (1.25, 0.5):  # roomy and overflowing
+        B, N, D, E = 2, 16, 8, 4
+        m_e = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                        capacity_factor=cf, drop=0.0)
+        m_i = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                        capacity_factor=cf, drop=0.0, dispatch="index")
+        x = jax.random.normal(jax.random.fold_in(key, 1), (B, N, D))
+        variables = {"params": m_e.init(key, x)["params"]}
+        y_e, aux_e = m_e.apply(variables, x, mutable=["losses"])
+        y_i, aux_i = m_i.apply(variables, x, mutable=["losses"])
+        np.testing.assert_allclose(np.asarray(y_i), np.asarray(y_e),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(aux_i)[0]),
+            np.asarray(jax.tree.leaves(aux_e)[0]), rtol=1e-6)
+
+
+def test_index_dispatch_gradients_match_einsum():
+    """Both dispatch modes differentiate to the same parameter gradients —
+    the gather/scatter-free combine must not detach any path."""
+    key = jax.random.PRNGKey(4)
+    B, N, D, E = 2, 12, 8, 4
+    x = jax.random.normal(jax.random.fold_in(key, 1), (B, N, D))
+    m_e = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                    capacity_factor=0.75, drop=0.0)
+    m_i = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                    capacity_factor=0.75, drop=0.0, dispatch="index")
+    params = m_e.init(key, x)["params"]
+
+    def loss(mod, p):
+        return jnp.sum(mod.apply({"params": p}, x) ** 2)
+
+    g_e = jax.grad(lambda p: loss(m_e, p))(params)
+    g_i = jax.grad(lambda p: loss(m_i, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        g_e, g_i)
+
+
+def test_index_dispatch_in_model_and_config(tmp_path, synthetic_image_dir):
+    """moe_dispatch threads YAML → config → model → SwitchMlp, validates its
+    values, and the index model trains a step."""
+    from ddim_cold_tpu.config import load_config
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+    from tests.test_train import _write_config
+
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        load_config(_write_config(str(tmp_path), synthetic_image_dir,
+                                  moe_dispatch="sparse"), "exp")
+    cfg = load_config(_write_config(str(tmp_path), synthetic_image_dir,
+                                    num_experts=2, moe_dispatch="index"),
+                      "exp")
+    assert cfg.model_kwargs()["moe_dispatch"] == "index"
+
+    model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32,
+                         depth=1, num_heads=2, num_experts=2,
+                         moe_dispatch="index")
+    r = np.random.RandomState(0)
+    batch = (jnp.asarray(r.randn(2, 16, 16, 3), jnp.float32),
+             jnp.asarray(r.randn(2, 16, 16, 3), jnp.float32),
+             jnp.asarray(r.randint(1, 7, size=(2,)), jnp.int32))
+    state = create_train_state(model, jax.random.PRNGKey(0), lr=1e-3,
+                               total_steps=10, sample_batch=batch)
+    step = make_train_step(model, moe_aux_weight=0.01)
+    state, loss, _ = step(state, batch, jax.random.PRNGKey(1),
+                          jnp.float32(5.0))
+    assert np.isfinite(float(loss))
