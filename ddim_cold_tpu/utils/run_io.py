@@ -47,3 +47,18 @@ def load_run(run_dir: str):
     params = ckpt.restore_checkpoint(
         os.path.join(run_dir, "bestloss.ckpt"), template)
     return config, model, params
+
+
+def default_val_dir(config, repo_root: str) -> str:
+    """The run's own validation split, resolved for the FID scripts'
+    ``--val-dir`` default — ONE policy shared by compute_fid.py and
+    fid_trend.py (a 200px run must not silently compare against the 64px
+    OxfordFlowers default; preflight-caught). Relative dataStorage paths
+    (the committed yamls' form) resolve against the repo root the trainer
+    runs from."""
+    val = config.data_storage[1]
+    if not val:
+        raise ValueError(
+            f"run yaml for {config.run_name!r} has no dataStorage val entry "
+            "— pass --val-dir explicitly")
+    return val if os.path.isabs(val) else os.path.join(repo_root, val)

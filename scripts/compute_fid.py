@@ -34,7 +34,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("run_dir", nargs="?", default=os.path.join(
         REPO, "Saved_Models", "20220822vit_tiny_diffusion"))
-    ap.add_argument("--val-dir", default=os.path.join(REPO, "OxfordFlowers", "val"))
+    ap.add_argument("--val-dir", default=None,
+                    help="real-image folder for the FID reference stream [default: the run config's own val dataStorage]")
     ap.add_argument("--n-samples", type=int, default=1024)
     ap.add_argument("--n-real", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=64)
@@ -64,6 +65,10 @@ def main(argv=None):
 
     # -- model from the run's own config + best checkpoint ------------------
     config, model, params = load_run(args.run_dir)
+    if args.val_dir is None:
+        from ddim_cold_tpu.utils.run_io import default_val_dir
+
+        args.val_dir = default_val_dir(config, REPO)
 
     # -- extractor ----------------------------------------------------------
     if args.inception_pth:

@@ -62,7 +62,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("run_dir", nargs="?", default=os.path.join(
         REPO, "Saved_Models", "20220822vit_tiny_diffusion"))
-    ap.add_argument("--val-dir", default=os.path.join(REPO, "OxfordFlowers", "val"))
+    ap.add_argument("--val-dir", default=None,
+                    help="real-image folder for the FID reference stream [default: the run config's own val dataStorage]")
     ap.add_argument("--n-samples", type=int, default=256,
                     help="samples per trend point (the headline fid.json uses "
                          "compute_fid.py's n=1024; trend points trade n for "
@@ -94,6 +95,10 @@ def main(argv=None):
 
     run_dir = args.run_dir
     config, model, template = load_run_template(run_dir)
+    if args.val_dir is None:
+        from ddim_cold_tpu.utils.run_io import default_val_dir
+
+        args.val_dir = default_val_dir(config, REPO)
 
     points = collect_points(run_dir, args.max_points)
 
