@@ -84,6 +84,14 @@ def main(argv=None):
         f"moe_dp{n}": ({"data": n}, {"num_experts": 4}),
         f"moe_dp{n//2}_ep2": ({"data": n // 2, "expert": 2},
                               {"num_experts": 4}),
+        # index-dispatch rows: same MoE model, sort/gather routing — the
+        # ratio against the einsum rows is the dispatch-implementation cost
+        # at this (short-sequence) scale; index exists for the O(N²·cf)
+        # regimes the einsum rows can't reach (models/moe.py)
+        f"moe_idx_dp{n}": ({"data": n},
+                           {"num_experts": 4, "moe_dispatch": "index"}),
+        f"moe_idx_dp{n//2}_ep2": ({"data": n // 2, "expert": 2},
+                                  {"num_experts": 4, "moe_dispatch": "index"}),
     }
 
     rng = np.random.RandomState(0)
@@ -127,16 +135,22 @@ def main(argv=None):
         print(f"[pbench] {name:12s} compile={compile_s:5.1f}s "
               f"{1000*dt:8.2f} ms/step", file=sys.stderr)
 
-    base = results[f"dp{n}"]
-    moe_base = results[f"moe_dp{n}"]  # missing baseline must fail loudly,
-    # never silently ratio the moe rows against dense dp
+    # explicit per-row baselines; a missing baseline must fail loudly, never
+    # silently ratio a row against the wrong model (moe vs dense) or the
+    # wrong dispatch implementation (index vs einsum)
+    baseline_of = {name: (f"moe_idx_dp{n}" if name.startswith("moe_idx_")
+                          else f"moe_dp{n}" if name.startswith("moe_")
+                          else f"dp{n}")
+                   for name in results}
+    # the index-dispatch dp row itself ratios against the einsum dp row:
+    # that ratio IS the dispatch-implementation cost at this scale
+    baseline_of[f"moe_idx_dp{n}"] = f"moe_dp{n}"
     for name, dt in results.items():
-        is_moe = name.startswith("moe_")
-        ref = moe_base if is_moe else base
+        ref_name = baseline_of[name]
         print(json.dumps({
             "layout": name, "ms_per_step": round(1000 * dt, 2),
-            "vs_dp": round(dt / ref, 3),
-            "baseline": f"moe_dp{n}" if is_moe else f"dp{n}",
+            "vs_dp": round(dt / results[ref_name], 3),
+            "baseline": ref_name,
             "note": "8 virtual CPU devices share one core: ratio ≈ total-work "
                     "overhead of the layout, not ICI speedup",
         }))
