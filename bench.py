@@ -33,6 +33,12 @@ import time
 
 BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
 
+#: --flash-block-sweep configs for the 200px north-star kernel tuning; the
+#: CPU tile-rule guard (tests/test_flash_attention.py) imports this list so
+#: every entry is pre-checked against Mosaic's (8, 128) rule before it can
+#: burn a slot in the one hardware window
+FLASH_BLOCK_SWEEP = ((512, 512), (256, 1024), (256, 4096), (512, 4096))
+
 #: e2e's generated temp dataset, registered so a watchdog abort (os._exit
 #: skips every finally) can still remove it instead of leaking 4096 images
 #: into /tmp per wedged round on the shared bench host
@@ -94,6 +100,11 @@ def main(argv=None):
                     help="sweep sampler stride k (BASELINE.json's k-sweep "
                          "config). Default: on, except under --smoke; pass "
                          "--ksweep/--no-ksweep to force either way")
+    ap.add_argument("--flash-block-sweep", action="store_true",
+                    help="in the north-star section, additionally time the "
+                         "flash kernel under alternative (block_q, block_kv) "
+                         "choices — kernel tuning for the 200px config; a "
+                         "few extra compiles of chip time")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (env JAX_PLATFORMS can be "
                          "overridden by site config; this flag always wins)")
@@ -511,6 +522,25 @@ def main(argv=None):
                     "n": n_big, "k": k}
             except Exception as e:  # noqa: BLE001 — recorded, never fatal
                 sub["northstar_n64_error"] = f"{type(e).__name__}: {e}"[:300]
+            if args.flash_block_sweep:
+                # kernel tuning: same params, alternative Pallas block
+                # sizes. 4096 clamps to the padded N inside the kernel —
+                # fully VMEM-resident K/V, a single chunk, no online-softmax
+                # loop. Best-effort per config (a VMEM overflow on one entry
+                # must not cost the others); the default-blocks headline
+                # above stays the comparable record.
+                sweep = {}
+                for bq, bkv in FLASH_BLOCK_SWEEP:
+                    bm = DiffusionViT(dtype=jnp.bfloat16, use_flash=True,
+                                      flash_blocks=(bq, bkv),
+                                      **MODEL_CONFIGS["oxford_flower_200_p4"])
+                    try:
+                        sdt = time_ddim(bm, ns_params, k, n,
+                                        f"north-star flash {bq}x{bkv}")
+                        sweep[f"{bq}x{bkv}"] = round(n / sdt, 2)
+                    except Exception as e:  # noqa: BLE001 — per-entry record
+                        sweep[f"{bq}x{bkv}"] = f"{type(e).__name__}: {e}"[:200]
+                sub["northstar_flash_block_sweep"] = sweep
 
         if not args.skip_northstar:
             section("northstar", run_northstar)

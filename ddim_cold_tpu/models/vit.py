@@ -122,6 +122,12 @@ class Attention(nn.Module):
     proj_drop: float = 0.0
     dtype: Dtype = jnp.float32
     use_flash: bool = False
+    # Pallas kernel block sizes (block_q, block_kv); None = the kernel's
+    # defaults. A tuning knob for long-sequence configs — e.g. block_kv >= N
+    # makes K/V fully VMEM-resident (single-chunk, no online-softmax loop).
+    # Applies to the plain flash path and ulysses' local flash attention;
+    # ring sp has its own per-device chunking and ignores it.
+    flash_blocks: Optional[tuple] = None
     # sequence parallelism: rotate K/V blocks around `seq_axis` of `seq_mesh`
     # (parallel/ring_attention.py); `batch_axis` keeps dp sharding composed,
     # `head_axis` keeps tensor-parallel head sharding effective inside the ring.
@@ -179,6 +185,7 @@ class Attention(nn.Module):
                     q, k, v, self.seq_mesh,
                     axis=self.seq_axis, batch_axis=self.batch_axis,
                     scale=scale, use_flash=self.use_flash,
+                    flash_blocks=self.flash_blocks,
                 ).astype(self.dtype)
             else:
                 from ddim_cold_tpu.parallel.ring_attention import ring_self_attention
@@ -192,7 +199,9 @@ class Attention(nn.Module):
         elif self.use_flash and weightless_ok:
             from ddim_cold_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, scale).astype(self.dtype)
+            # None defers to the kernel's own defaults — one source of truth
+            out = flash_attention(
+                q, k, v, scale, *(self.flash_blocks or ())).astype(self.dtype)
             attn = None
         else:
             logits = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
@@ -225,6 +234,7 @@ class Block(nn.Module):
     drop_path: float = 0.0
     dtype: Dtype = jnp.float32
     use_flash: bool = False
+    flash_blocks: Optional[tuple] = None
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = None
@@ -248,6 +258,7 @@ class Block(nn.Module):
             proj_drop=self.drop,
             dtype=self.dtype,
             use_flash=self.use_flash,
+            flash_blocks=self.flash_blocks,
             seq_mesh=self.seq_mesh,
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
@@ -313,7 +324,7 @@ def block_template(model: "DiffusionViT") -> "Block":
         dim=model.embed_dim, num_heads=model.num_heads, mlp_ratio=model.mlp_ratio,
         qkv_bias=model.qkv_bias, qk_scale=model.qk_scale, drop=model.drop_rate,
         attn_drop=model.attn_drop_rate, drop_path=0.0, dtype=model.dtype,
-        use_flash=model.use_flash,
+        use_flash=model.use_flash, flash_blocks=model.flash_blocks,
     )
 
 
@@ -395,6 +406,7 @@ class DiffusionViT(nn.Module):
     dtype: Dtype = jnp.float32
     use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
     use_flash: bool = False  # Pallas fused attention (long-seq configs)
+    flash_blocks: Optional[tuple] = None  # (block_q, block_kv) kernel tuning
     remat: bool = False  # jax.checkpoint each block: recompute activations in
     # backward instead of holding depth× residuals in HBM (big-config training)
     # sequence parallelism (ring attention over `seq_axis` of `seq_mesh`;
@@ -533,6 +545,7 @@ class DiffusionViT(nn.Module):
                     drop_path=float(dpr[i]),
                     dtype=self.dtype,
                     use_flash=self.use_flash,
+                    flash_blocks=self.flash_blocks,
                     seq_mesh=self.seq_mesh,
                     seq_axis=self.seq_axis,
                     batch_axis=self.batch_axis,

@@ -39,6 +39,7 @@ def ulysses_self_attention(
     batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
     use_flash: bool = False,
+    flash_blocks: Optional[tuple] = None,
 ) -> jax.Array:
     """Global-array front end, mirror of ``ring_self_attention``.
 
@@ -74,7 +75,8 @@ def ulysses_self_attention(
         if use_flash:
             from ddim_cold_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(qf, kf, vf, scale).astype(q.dtype)
+            out = flash_attention(
+                qf, kf, vf, scale, *(flash_blocks or ())).astype(q.dtype)
         else:
             logits = jnp.einsum(
                 "bnhd,bmhd->bhnm", qf.astype(jnp.float32),

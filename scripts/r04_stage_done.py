@@ -34,8 +34,12 @@ def stage_done(stage: str) -> bool:
         if not is_tpu_record(rec):
             return False
         sub = rec.get("submetrics", {})
-        return ("sampler_throughput_200px_k20_flash" in sub
-                or "northstar_error" in sub)
+        # a completed stage means the flash number AND the block sweep (a
+        # watchdog abort between the two must re-run the stage) — or a
+        # recorded flash failure, which IS the round's artifact
+        return ("northstar_error" in sub
+                or ("sampler_throughput_200px_k20_flash" in sub
+                    and "northstar_flash_block_sweep" in sub))
     if stage == "validate":
         try:
             with open(res("tpu_validate_r04.txt")) as f:

@@ -51,6 +51,7 @@ run_stage() { # $1 = stage key, $2 = label, $3... = command
 # stage 0 — the north-star flash/dense 200px sampler record (+ b32 headline)
 ns() {
   python bench.py --skip-e2e --skip-scaling --skip-sampler --no-ksweep \
+    --flash-block-sweep \
     > results/bench_r04_northstar.json 2> results/bench_r04_northstar.log
 }
 run_stage northstar "north-star bench" ns

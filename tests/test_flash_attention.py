@@ -122,6 +122,22 @@ def test_model_use_flash_parity():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_model_flash_blocks_tuning_matches_default():
+    """flash_blocks threads model → Attention → kernel and changes only the
+    schedule, never the numbers — including a block_kv far past N (clamped
+    inside the kernel to the padded sequence: fully VMEM-resident K/V)."""
+    cfg = dict(img_size=(16, 16), patch_size=4, embed_dim=32, depth=2, num_heads=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    t = jnp.array([3, 500], jnp.int32)
+    base = DiffusionViT(use_flash=True, **cfg)
+    params = base.init(jax.random.PRNGKey(1), x, t)["params"]
+    want = np.asarray(base.apply({"params": params}, x, t))
+    for blocks in ((8, 8), (16, 4096)):
+        tuned = DiffusionViT(use_flash=True, flash_blocks=blocks, **cfg)
+        got = np.asarray(tuned.apply({"params": params}, x, t))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
 def test_model_attention_probe_still_works_with_flash():
     """return_attention_layer forces the weights-producing path even when
     use_flash is on (the kernel never materializes attention weights)."""
@@ -136,16 +152,11 @@ def test_model_attention_probe_still_works_with_flash():
     np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
 
 
-def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
-    """Every BlockSpec the kernels build must satisfy Mosaic's TPU tiling
-    rule: the last two dims of a block are divisible by (8, 128) or equal
-    the array's. CPU interpret mode never enforces this, which let a
-    (1, bq) lse row block ship and fail to compile on real hardware at the
-    200px config (N=2501, BH=64) — this guard reproduces the check the TPU
-    lowering applies, against the real pallas_call arguments."""
+def _tile_rule_spy(monkeypatch, fa):
+    """Install a pallas_call spy asserting every BlockSpec satisfies the TPU
+    (8, 128) tile rule against the real call arguments; returns the call-name
+    list for count assertions."""
     from jax.experimental import pallas as pl
-
-    from ddim_cold_tpu.ops import flash_attention as fa
 
     def check(block, arr, ctx):
         assert len(block) == len(arr), (ctx, block, arr)
@@ -178,6 +189,36 @@ def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
         return wrapper
 
     monkeypatch.setattr(fa.pl, "pallas_call", spy)
+    return calls
+
+
+def test_block_sweep_configs_satisfy_tpu_tile_rule(monkeypatch):
+    """The bench's --flash-block-sweep configs at the exact 200px shape
+    (N=2501) must pass the same tile rule — a sweep entry that Mosaic
+    rejects on chip would burn its slot in the one hardware window."""
+    from ddim_cold_tpu.ops import flash_attention as fa
+
+    from bench import FLASH_BLOCK_SWEEP
+
+    calls = _tile_rule_spy(monkeypatch, fa)
+    q, k, v = _rand_qkv(11, 1, 2501, 1, 64)  # 1 head: forward-only sweep
+    for bq, bkv in FLASH_BLOCK_SWEEP:
+        out = flash_attention(q, k, v, 64**-0.5, bq, bkv)
+        assert np.isfinite(np.asarray(out)).all(), (bq, bkv)
+    assert calls.count("_fwd_kernel") == len(FLASH_BLOCK_SWEEP), calls
+    assert len(calls) == len(FLASH_BLOCK_SWEEP), calls
+
+
+def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
+    """Every BlockSpec the kernels build must satisfy Mosaic's TPU tiling
+    rule: the last two dims of a block are divisible by (8, 128) or equal
+    the array's. CPU interpret mode never enforces this, which let a
+    (1, bq) lse row block ship and fail to compile on real hardware at the
+    200px config (N=2501, BH=64) — this guard reproduces the check the TPU
+    lowering applies, against the real pallas_call arguments."""
+    from ddim_cold_tpu.ops import flash_attention as fa
+
+    calls = _tile_rule_spy(monkeypatch, fa)
     # 65 = vit_tiny, 257 = oxford_flower_64, 2501 = the 200px north-star
     # shape that failed on hardware (keep it last: largest)
     for N, H, D in ((65, 12, 32), (257, 4, 64), (2501, 4, 64)):
