@@ -68,6 +68,17 @@ def main():
                                 jnp.float32(5.0))
     loss = float(loss)  # global-mean loss: identical on both processes
 
+    # grouped (steps_per_dispatch) sharding across REAL processes: each host
+    # contributes its (n, local_B, …) stack and the P(None, 'data') global
+    # assembles — the multi-host form of the grouped-dispatch batch contract
+    grouped_local = tuple(np.stack([a, a]) for a in local)
+    gbatch = shard_batch(grouped_local, mesh, grouped=True)
+    assert not gbatch[0].is_fully_addressable
+    multi_step = make_train_step(model, steps_per_dispatch=2)
+    state, gloss, _ = multi_step(state, gbatch, jax.random.PRNGKey(1),
+                                 jnp.float32(5.0))
+    assert np.isfinite(float(gloss)), gloss
+
     # collective orbax save: every process calls save (trainer.py:284-287)
     ckpt.save_checkpoint(os.path.join(out_dir, "ckpt"), state.params)
 
