@@ -41,9 +41,12 @@ def main(sample_n, acc_k, config_name, checkpoint, init_random, seed, eta):
     from ddim_cold_tpu.models import MODEL_CONFIGS, DiffusionViT
     from ddim_cold_tpu.ops import sampling
     from ddim_cold_tpu.utils import checkpoint as ckpt
-    from ddim_cold_tpu.utils.platform import enable_compile_cache, honor_env_platform
+    from ddim_cold_tpu.utils.platform import (
+        enable_compile_cache, honor_env_platform, require_accelerator_or_exit,
+    )
 
     honor_env_platform()
+    require_accelerator_or_exit()  # wedged tunnel: exit 3, never hang
     enable_compile_cache()  # repeat CLI runs reuse compiled XLA programs
     from ddim_cold_tpu.utils.image import get_next_path, grid_shape, save_grid
 

@@ -30,6 +30,44 @@ def honor_env_platform() -> None:
     jax.config.update("jax_platforms", want)
 
 
+def require_accelerator_or_exit(attempts: int = 1) -> None:
+    """CLI guard for accelerator-intended runs: bound the first backend init
+    (a wedged remote-TPU tunnel blocks ``jax.devices()`` FOREVER — an
+    unguarded CLI strands any unattended chain that invoked it), and if an
+    accelerator was configured but is unreachable, exit 3 with an actionable
+    message instead of silently degrading a production run to one CPU core.
+    CPU-pinned invocations (``JAX_PLATFORMS=cpu`` / ``--cpu``) skip the
+    probe entirely and are unaffected — deliberate CPU use stays first-class
+    (the whole test suite runs that way).
+
+    ``attempts=1`` deliberately (vs bench's 3-with-backoff budget): exit-3
+    callers lose nothing by failing after one bounded probe — a recovery
+    watcher re-arms them — where the bench's CPU fallback would lose the
+    round's hardware record.
+    """
+    # coordinated multi-host launch: backend init requires ALL hosts to
+    # rendezvous, so a lone probe subprocess would time out on perfectly
+    # healthy hardware — the guard targets the single-host wedged-tunnel
+    # case and must stand down here. TPU_WORKER_HOSTNAMES counts only when
+    # it actually lists multiple workers: single-host sites (the axon
+    # tunnel image among them) set it to 'localhost'.
+    if any(os.environ.get(v) for v in
+           ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS")):
+        return
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return
+    plat, reason = ensure_live_backend(attempts=attempts)
+    if plat == "cpu":
+        import sys
+
+        print(
+            f"ERROR: configured accelerator backend unreachable ({reason}); "
+            "set JAX_PLATFORMS=cpu (or pass --cpu where available) to run "
+            "on CPU deliberately", file=sys.stderr)
+        raise SystemExit(3)
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at a repo-local directory.
 

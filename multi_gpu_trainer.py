@@ -34,17 +34,25 @@ def main(argv, base_dir=None):
             yaml_path = cand
     config = load_config(yaml_path, exp_name)
 
+    from ddim_cold_tpu.train.trainer import run
+    from ddim_cold_tpu.utils.platform import (
+        honor_env_platform, require_accelerator_or_exit,
+    )
+
+    honor_env_platform()  # JAX_PLATFORMS env must beat any site-config pin
+    # an accelerator-configured production run must fail fast on a wedged
+    # tunnel (exit 3 re-arms recovery chains) — never hang in jax.devices()
+    # and never silently train the config on one CPU core. BEFORE any
+    # filesystem side effect: an exit-3 must not leave a yaml-only stub
+    # run dir behind to fool evidence checks.
+    require_accelerator_or_exit()
+
     saved_dir = os.path.join(base, "Saved_Models")
     run_dir = os.path.join(saved_dir, config.run_name)
     if os.path.isdir(run_dir):
         print("Warning!Current folder already exist!")
     os.makedirs(run_dir, exist_ok=True)
     shutil.copy(yaml_path, run_dir)
-
-    from ddim_cold_tpu.train.trainer import run
-    from ddim_cold_tpu.utils.platform import honor_env_platform
-
-    honor_env_platform()  # JAX_PLATFORMS env must beat any site-config pin
 
     result = run(config, base)
     print(f"\nbest val loss {result.best_loss:.5f} after {result.steps} steps "

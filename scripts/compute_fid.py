@@ -58,6 +58,10 @@ def main(argv=None):
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from ddim_cold_tpu.utils.platform import require_accelerator_or_exit
+
+        require_accelerator_or_exit()  # wedged tunnel: exit 3, never hang
     from ddim_cold_tpu.data import ColdDownSampleDataset, ShardedLoader
     from ddim_cold_tpu.eval import fid, inception
     from ddim_cold_tpu.ops import sampling

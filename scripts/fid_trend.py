@@ -76,7 +76,9 @@ def main(argv=None):
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
 
-    from ddim_cold_tpu.utils.platform import ensure_live_backend, honor_env_platform
+    from ddim_cold_tpu.utils.platform import (
+        honor_env_platform, require_accelerator_or_exit,
+    )
 
     honor_env_platform()
     import jax
@@ -84,7 +86,9 @@ def main(argv=None):
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
-        ensure_live_backend()  # wedged tunnel → CPU instead of hanging
+        # exit 3 on a wedged tunnel: a silent CPU fallback at 200px would
+        # look exactly like the hang it was meant to avoid
+        require_accelerator_or_exit()
     import numpy as np
 
     from ddim_cold_tpu.data import ColdDownSampleDataset, ShardedLoader

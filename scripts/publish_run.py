@@ -124,6 +124,10 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from ddim_cold_tpu.utils.platform import require_accelerator_or_exit
+
+        require_accelerator_or_exit()  # wedged tunnel: exit 3, never hang
 
     run = os.path.basename(os.path.normpath(args.run_dir))
     out_dir = os.path.join(REPO, "results", run)

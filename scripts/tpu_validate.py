@@ -29,8 +29,15 @@ def main():
 
     import jax
 
+    from ddim_cold_tpu.utils.platform import (
+        honor_env_platform, require_accelerator_or_exit,
+    )
+
+    honor_env_platform()  # JAX_PLATFORMS env must beat any site-config pin
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        require_accelerator_or_exit()  # wedged tunnel: exit 3, never hang
     import jax.numpy as jnp
     import numpy as np
 

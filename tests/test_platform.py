@@ -141,3 +141,40 @@ def test_honor_env_platform_reapplies_env(monkeypatch):
     monkeypatch.delenv("JAX_PLATFORMS")
     plat.honor_env_platform()  # unset env → no-op
     assert (jax.config.jax_platforms or "").split(",")[0] == "cpu"
+
+
+def test_require_accelerator_or_exit(monkeypatch):
+    """The CLI guard: pass-through when the backend is live or cpu-pinned,
+    SystemExit(3) when an accelerator was configured but unreachable."""
+    monkeypatch.setattr(plat, "ensure_live_backend",
+                        lambda attempts=3: ("default", "probe ok"))
+    plat.require_accelerator_or_exit()  # no raise
+
+    monkeypatch.setattr(plat, "ensure_live_backend",
+                        lambda attempts=3: ("cpu", "backend init probe hung"))
+    # single-host TPU_WORKER_HOSTNAMES values (the axon image sets
+    # 'localhost') must NOT disable the guard
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    with pytest.raises(SystemExit) as e:
+        plat.require_accelerator_or_exit()
+    assert e.value.code == 3
+
+    # coordinated multi-host launches stand the guard down — a lone probe
+    # cannot rendezvous a pod slice and would fail on healthy hardware
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    plat.require_accelerator_or_exit()  # no raise
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    plat.require_accelerator_or_exit()  # no raise
+
+
+def test_enable_compile_cache_env_off(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setenv("DDIM_COLD_COMPILE_CACHE", "off")
+    assert plat.enable_compile_cache() is None
+    monkeypatch.setenv("DDIM_COLD_COMPILE_CACHE", str(tmp_path / "cc"))
+    assert plat.enable_compile_cache() == str(tmp_path / "cc")
+    # restore the suite-wide cache dir (conftest.py) for later tests
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
