@@ -302,3 +302,22 @@ def test_index_dispatch_in_model_and_config(tmp_path, synthetic_image_dir):
     state, loss, _ = step(state, batch, jax.random.PRNGKey(1),
                           jnp.float32(5.0))
     assert np.isfinite(float(loss))
+
+
+def test_index_dispatch_long_sequence_parity():
+    """N=2501 (the 200px/p4 token count): the index path matches the einsum
+    path at the scale it exists for. B=1 keeps the einsum reference's
+    (B, N, E, C) dispatch tensor affordable (~31 MB) — at training batch
+    sizes only the index path is viable, which is the point."""
+    key = jax.random.PRNGKey(5)
+    N, D, E = 2501, 32, 4
+    m_e = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                    capacity_factor=1.25, drop=0.0)
+    m_i = SwitchMlp(num_experts=E, hidden_features=D, out_features=D,
+                    capacity_factor=1.25, drop=0.0, dispatch="index")
+    x = jax.random.normal(jax.random.fold_in(key, 1), (1, N, D))
+    variables = {"params": m_e.init(key, x)["params"]}
+    y_e = m_e.apply(variables, x)
+    y_i = m_i.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y_i), np.asarray(y_e),
+                               rtol=2e-5, atol=2e-6)
