@@ -34,6 +34,8 @@ def stage_done(stage: str) -> bool:
         if not is_tpu_record(rec):
             return False
         sub = rec.get("submetrics", {})
+        if "captured_earlier" in sub:
+            return False  # a reused record is never stage evidence
         # a completed stage means the flash number AND the block sweep (a
         # watchdog abort between the two must re-run the stage) — or a
         # recorded flash failure, which IS the round's artifact
@@ -50,6 +52,8 @@ def stage_done(stage: str) -> bool:
         rec = last_json_record(res("bench_r04_tpu.json"))
         if not (is_tpu_record(rec) and rec.get("value")):
             return False
+        if "captured_earlier" in rec.get("submetrics", {}):
+            return False  # a reused record is never stage evidence
         rows = rec.get("submetrics", {}).get("batch_scaling", [])
         return any(row.get("batch") == 512 for row in rows)
     if stage == "train200":

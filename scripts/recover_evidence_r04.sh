@@ -51,7 +51,7 @@ run_stage() { # $1 = stage key, $2 = label, $3... = command
 # stage 0 — the north-star flash/dense 200px sampler record (+ b32 headline)
 ns() {
   python bench.py --skip-e2e --skip-scaling --skip-sampler --no-ksweep \
-    --flash-block-sweep \
+    --flash-block-sweep --no-reuse \
     > results/bench_r04_northstar.json 2> results/bench_r04_northstar.log
 }
 run_stage northstar "north-star bench" ns
@@ -62,7 +62,7 @@ run_stage validate "tpu_validate numerics" val
 
 # stage 2 — the full round-4 bench record (scaling→b1024, remat, e2e+spd)
 fb() {
-  python bench.py > results/bench_r04_tpu.json 2> results/bench_r04_tpu.log
+  python bench.py --no-reuse > results/bench_r04_tpu.json 2> results/bench_r04_tpu.log
 }
 run_stage fullbench "full bench" fb
 

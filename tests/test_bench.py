@@ -84,6 +84,25 @@ def test_reuse_round_record(tmp_path):
     with open(path, "w") as f:
         f.write(json.dumps(dict(rec, value=None)) + "\n")
     assert bench._reuse_round_record("probe hung", root=root) is None
+    # tunnel down the WHOLE round (no r04 record at all): the newest prior
+    # round's committed record is reused, loudly labeled stale
+    os.remove(path)
+    with open(os.path.join(root, "results", "bench_r03_tpu.json"), "w") as f:
+        f.write(json.dumps(dict(rec, value=613.0)) + "\n")
+    got = bench._reuse_round_record("probe hung", root=root)
+    assert got and got["value"] == 613.0
+    assert got["submetrics"]["captured_earlier"]["stale_round"] == 3
+    assert "not a fresh measurement" in got["submetrics"]["captured_earlier"]["note"]
+    # sticky staleness: if that reused record later sits in a same-round
+    # file, re-reusing it must PRESERVE the stale provenance, not relabel
+    # it as a plain same-round capture
+    with open(path, "w") as f:
+        f.write(json.dumps(got) + "\n")
+    again = bench._reuse_round_record("probe hung again", root=root)
+    ce = again["submetrics"]["captured_earlier"]
+    assert ce["stale_round"] == 3 and "not a fresh measurement" in ce["note"]
+    assert ce["file"].endswith("bench_r03_tpu.json")  # original provenance
+    assert ce["live_probe"] == "probe hung again"
 
 
 def test_bench_e2e_section_runs_on_cpu():
