@@ -138,6 +138,32 @@ def test_model_flash_blocks_tuning_matches_default():
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
 
 
+def test_model_remat_flash_gradients_match():
+    """remat (jax.checkpoint per block) composed with the flash custom-VJP:
+    the memory-tight 200px training combination. Gradients must equal the
+    non-remat flash model's — recompute may not perturb the custom backward."""
+    import jax.numpy as jnp
+
+    cfg = dict(img_size=(16, 16), patch_size=4, embed_dim=32, depth=2,
+               num_heads=4, drop_rate=0.0, attn_drop_rate=0.0,
+               drop_path_rate=0.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    t = jnp.array([3, 500], jnp.int32)
+    base = DiffusionViT(use_flash=True, **cfg)
+    params = base.init(jax.random.PRNGKey(1), x, t)["params"]
+    rem = DiffusionViT(use_flash=True, remat=True, **cfg)
+
+    def loss(model, p):
+        return jnp.sum(model.apply({"params": p}, x, t) ** 2)
+
+    g_base = jax.grad(lambda p: loss(base, p))(params)
+    g_rem = jax.grad(lambda p: loss(rem, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        g_base, g_rem)
+
+
 def test_model_attention_probe_still_works_with_flash():
     """return_attention_layer forces the weights-producing path even when
     use_flash is on (the kernel never materializes attention weights)."""
