@@ -25,7 +25,14 @@ note "=== r04 chain start (pid $$, attempt $A) ==="
 commit_evidence() { # $1 = message
   git add -A results/ >>"$LOG" 2>&1
   if ! git diff --cached --quiet; then
-    if git commit -q -m "$1" -m "No-Verification-Needed: evidence-only capture (results/ artifacts, no source change)" >>"$LOG" 2>&1; then
+    # identity fallback: a re-imaged host may lose git config — evidence
+    # must still commit, authored like the repo's existing history
+    local -a idargs=()
+    if ! git config user.email >/dev/null 2>&1; then
+      idargs=(-c "user.name=$(git log -1 --format='%an')" \
+              -c "user.email=$(git log -1 --format='%ae')")
+    fi
+    if git "${idargs[@]}" commit -q -m "$1" -m "No-Verification-Needed: evidence-only capture (results/ artifacts, no source change)" >>"$LOG" 2>&1; then
       note "committed: $1"
     else
       note "commit FAILED: $1"
