@@ -517,25 +517,50 @@ def main(argv=None):
             n, k = 16, 20
             ns_params = None
             flash_model = None
-            for flash in (False, True):
-                ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
+            # three attention paths: dense einsum (the reference semantics),
+            # the Pallas fused kernel, and the pure-XLA blockwise safety net
+            # (compiles even where Mosaic rejects the kernel — Mosaic DID
+            # reject once at this exact shape, r03). Each leg is its own
+            # best-effort section-within-a-section via time_ddim's memo.
+            flash_exc = None
+            for impl, suffix in ((False, "_dense"), (True, "_flash"),
+                                 ("xla", "_xla")):
+                ns_model = DiffusionViT(dtype=jnp.bfloat16, use_flash=impl,
                                         **MODEL_CONFIGS["oxford_flower_200_p4"])
-                if flash:
+                if impl is True:
                     flash_model = ns_model
                 if ns_params is None:
                     mark("north-star 200px param init")
                     ns_params = ns_model.init(
                         jax.random.PRNGKey(0),
                         jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
-                sdt = time_ddim(ns_model, ns_params, k, n,
-                                f"north-star 200px flash={int(flash)}")
-                sub["sampler_throughput_200px_k20" + ("_flash" if flash else "_dense")] = {
+                try:
+                    sdt = time_ddim(ns_model, ns_params, k, n,
+                                    f"north-star 200px {suffix[1:]}")
+                except Exception as e:  # noqa: BLE001 — one path's failure
+                    # (e.g. a Mosaic rejection) must not cost the others
+                    sub["northstar" + suffix + "_error"] = (
+                        f"{type(e).__name__}: {e}"[:300])
+                    if impl is True:
+                        flash_exc = e  # re-raised below: section() must
+                        # RETRY a possibly-transient flash failure (the
+                        # memoized other legs skip on retry); a persistent
+                        # one ends as a section-level northstar_error
+                    continue
+                sub["sampler_throughput_200px_k20" + suffix] = {
                     "value": round(n / sdt, 2), "unit": "img/s/chip", "n": n, "k": k}
-            # headline north-star alias = the faster of the two attention paths
-            best = max(sub["sampler_throughput_200px_k20_flash"]["value"],
-                       sub["sampler_throughput_200px_k20_dense"]["value"])
-            sub["sampler_throughput_200px_k20"] = {
-                "value": best, "unit": "img/s/chip", "n": n, "k": k}
+            # headline north-star alias = the fastest path that ran
+            vals = [leg["value"] for leg in
+                    (sub.get("sampler_throughput_200px_k20" + s)
+                     for s in ("_dense", "_flash", "_xla")) if leg]
+            if vals:
+                sub["sampler_throughput_200px_k20"] = {
+                    "value": max(vals), "unit": "img/s/chip", "n": n, "k": k}
+            if flash_exc is not None:
+                # do NOT re-attempt the Pallas path (n64 leg, block sweep)
+                # after it just failed — each re-attempt would re-pay the
+                # failed multi-minute compile on chip time
+                raise flash_exc
             # best-achievable leg (separate submetric — the headline above stays
             # pinned to the n=16 definition BASELINE.json publishes): flash never
             # materializes the N² attention matrix (dense at N=2501 burns

@@ -67,7 +67,7 @@ class ExperimentConfig:
     seed: int = 42
     honor_diff_step: bool = False
     mesh: Optional[dict[str, int]] = None
-    use_flash: bool = False
+    use_flash: object = False  # False | True (Pallas) | "xla" (blockwise)
     use_sincos_pos: bool = False
     sp_mode: str = "ring"  # seq-parallel strategy: ring | ulysses
     remat: bool = False
@@ -169,6 +169,20 @@ class ExperimentConfig:
         )
 
 
+def _check_use_flash(value):
+    # YAML surface: false | true (Pallas kernel) | "xla" (pure-XLA blockwise)
+    if isinstance(value, str):
+        if value.lower() in ("xla",):
+            return "xla"
+        if value.lower() in ("pallas", "true"):
+            return True
+        if value.lower() in ("false", "none", ""):
+            return False
+        raise ValueError(
+            f"use_flash must be true/false/'xla'/'pallas', got {value!r}")
+    return bool(value)
+
+
 def _check_sp_mode(value: str) -> str:
     if value not in ("ring", "ulysses"):
         raise ValueError(f"sp_mode must be 'ring' or 'ulysses', got {value!r}")
@@ -249,7 +263,7 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         seed=int(raw.get("seed", 42)),
         honor_diff_step=bool(raw.get("honor_diff_step", False)),
         mesh=raw.get("mesh"),
-        use_flash=bool(raw.get("use_flash", False)),
+        use_flash=_check_use_flash(raw.get("use_flash", False)),
         use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
         sp_mode=_check_sp_mode(raw.get("sp_mode", "ring")),
         remat=bool(raw.get("remat", False)),

@@ -121,7 +121,9 @@ class Attention(nn.Module):
     attn_drop: float = 0.0
     proj_drop: float = 0.0
     dtype: Dtype = jnp.float32
-    use_flash: bool = False
+    # False = dense einsum; True = Pallas fused kernel; "xla" = pure-XLA
+    # blockwise online-softmax (no kernel to reject, bounded memory)
+    use_flash: object = False
     # Pallas kernel block sizes (block_q, block_kv); None = the kernel's
     # defaults. A tuning knob for long-sequence configs — e.g. block_kv >= N
     # makes K/V fully VMEM-resident (single-chunk, no online-softmax loop).
@@ -197,11 +199,25 @@ class Attention(nn.Module):
                 ).astype(self.dtype)
             attn = None
         elif self.use_flash and weightless_ok:
-            from ddim_cold_tpu.ops.flash_attention import flash_attention
+            if self.use_flash == "xla":
+                # pure-XLA blockwise path: no Pallas to reject, bounded
+                # memory — the safety net / inference middle path (its scan
+                # backward saves per-block carries, so prefer the kernel for
+                # training where it lowers)
+                from ddim_cold_tpu.ops.flash_attention import (
+                    blockwise_attention_xla,
+                )
 
-            # None defers to the kernel's own defaults — one source of truth
-            out = flash_attention(
-                q, k, v, scale, *(self.flash_blocks or ())).astype(self.dtype)
+                out = blockwise_attention_xla(
+                    q, k, v, scale,
+                    *((self.flash_blocks[1],) if self.flash_blocks else ())
+                ).astype(self.dtype)
+            else:
+                from ddim_cold_tpu.ops.flash_attention import flash_attention
+
+                # None defers to the kernel's own defaults — one source of truth
+                out = flash_attention(
+                    q, k, v, scale, *(self.flash_blocks or ())).astype(self.dtype)
             attn = None
         else:
             logits = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
@@ -233,7 +249,7 @@ class Block(nn.Module):
     attn_drop: float = 0.0
     drop_path: float = 0.0
     dtype: Dtype = jnp.float32
-    use_flash: bool = False
+    use_flash: object = False  # False | True (Pallas) | "xla" (blockwise)
     flash_blocks: Optional[tuple] = None
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
@@ -405,7 +421,9 @@ class DiffusionViT(nn.Module):
     total_steps: int = 2000
     dtype: Dtype = jnp.float32
     use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
-    use_flash: bool = False  # Pallas fused attention (long-seq configs)
+    use_flash: object = False  # False=dense | True=Pallas fused | "xla"=
+    # pure-XLA blockwise online-softmax (long-seq configs; "xla" is the
+    # Mosaic-free safety net)
     flash_blocks: Optional[tuple] = None  # (block_q, block_kv) kernel tuning
     remat: bool = False  # jax.checkpoint each block: recompute activations in
     # backward instead of holding depth× residuals in HBM (big-config training)

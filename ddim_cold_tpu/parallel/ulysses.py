@@ -38,7 +38,7 @@ def ulysses_self_attention(
     axis: str = "seq",
     batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
-    use_flash: bool = False,
+    use_flash: object = False,  # False | True (Pallas) | "xla" (blockwise)
     flash_blocks: Optional[tuple] = None,
 ) -> jax.Array:
     """Global-array front end, mirror of ``ring_self_attention``.
@@ -72,7 +72,13 @@ def ulysses_self_attention(
         qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H/S, D)
         qf, kf, vf = (x[:, :N] for x in (qf, kf, vf))  # drop ring padding
 
-        if use_flash:
+        if use_flash == "xla":
+            from ddim_cold_tpu.ops.flash_attention import blockwise_attention_xla
+
+            out = blockwise_attention_xla(
+                qf, kf, vf, scale,
+                *((flash_blocks[1],) if flash_blocks else ())).astype(q.dtype)
+        elif use_flash:
             from ddim_cold_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(

@@ -38,7 +38,10 @@ def stage_done(stage: str) -> bool:
             return False  # a reused record is never stage evidence
         # a completed stage means the flash number AND the block sweep (a
         # watchdog abort between the two must re-run the stage) — or a
-        # recorded flash failure, which IS the round's artifact
+        # SECTION-level northstar_error, which only lands after the
+        # section's retry also failed (bench re-raises flash-leg failures
+        # precisely so transient ones get that retry); the per-leg
+        # northstar_flash_error key alone is NOT terminal
         return ("northstar_error" in sub
                 or ("sampler_throughput_200px_k20_flash" in sub
                     and "northstar_flash_block_sweep" in sub))

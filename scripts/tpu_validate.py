@@ -85,7 +85,7 @@ def main():
     if not args.quick:
         # the 20-step bf16 sampler accumulation at 200px, both attention paths
         # (bench only times these — numerics are asserted here)
-        for flash in (False, True):
+        for flash in (False, True, "xla"):
             m2 = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
                               **MODEL_CONFIGS["oxford_flower_200_p4"])
             p2 = m2.init(jax.random.PRNGKey(0), jnp.zeros((1, 200, 200, 3)),

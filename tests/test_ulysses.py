@@ -43,12 +43,14 @@ def test_ulysses_composes_with_dp():
                                rtol=2e-5, atol=2e-6)
 
 
-def test_ulysses_flash_local_attention():
-    """use_flash=True runs the Pallas kernel per shard inside the shard_map."""
+@pytest.mark.parametrize("impl", [True, "xla"])
+def test_ulysses_flash_local_attention(impl):
+    """use_flash=True runs the Pallas kernel per shard inside the shard_map;
+    use_flash='xla' runs the pure-XLA blockwise path there instead."""
     mesh = make_mesh({"data": 2, "seq": 4})
     q, k, v = _qkv(2, 1, 40, 4, 8)
     scale = 8**-0.5
-    out = ulysses_self_attention(q, k, v, mesh, scale=scale, use_flash=True)
+    out = ulysses_self_attention(q, k, v, mesh, scale=scale, use_flash=impl)
     _, want = _dense_attention_f32(q, k, v, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-5, atol=2e-6)
