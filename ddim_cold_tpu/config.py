@@ -67,7 +67,7 @@ class ExperimentConfig:
     seed: int = 42
     honor_diff_step: bool = False
     mesh: Optional[dict[str, int]] = None
-    use_flash: object = False  # False | True (Pallas) | "xla" (blockwise)
+    use_flash: "bool | str" = False  # False | True (Pallas) | "xla" (blockwise)
     use_sincos_pos: bool = False
     sp_mode: str = "ring"  # seq-parallel strategy: ring | ulysses
     remat: bool = False
@@ -172,7 +172,7 @@ class ExperimentConfig:
 def _check_use_flash(value):
     # YAML surface: false | true (Pallas kernel) | "xla" (pure-XLA blockwise)
     if isinstance(value, str):
-        if value.lower() in ("xla",):
+        if value.lower() == "xla":
             return "xla"
         if value.lower() in ("pallas", "true"):
             return True

@@ -123,7 +123,7 @@ class Attention(nn.Module):
     dtype: Dtype = jnp.float32
     # False = dense einsum; True = Pallas fused kernel; "xla" = pure-XLA
     # blockwise online-softmax (no kernel to reject, bounded memory)
-    use_flash: object = False
+    use_flash: "bool | str" = False
     # Pallas kernel block sizes (block_q, block_kv); None = the kernel's
     # defaults. A tuning knob for long-sequence configs — e.g. block_kv >= N
     # makes K/V fully VMEM-resident (single-chunk, no online-softmax loop).
@@ -249,7 +249,7 @@ class Block(nn.Module):
     attn_drop: float = 0.0
     drop_path: float = 0.0
     dtype: Dtype = jnp.float32
-    use_flash: object = False  # False | True (Pallas) | "xla" (blockwise)
+    use_flash: "bool | str" = False  # False | True (Pallas) | "xla" (blockwise)
     flash_blocks: Optional[tuple] = None
     seq_mesh: Optional[Mesh] = None
     seq_axis: Optional[str] = None
@@ -421,7 +421,7 @@ class DiffusionViT(nn.Module):
     total_steps: int = 2000
     dtype: Dtype = jnp.float32
     use_sincos_pos: bool = False  # fixed sinusoidal pos table for >64px configs (C7)
-    use_flash: object = False  # False=dense | True=Pallas fused | "xla"=
+    use_flash: "bool | str" = False  # False=dense | True=Pallas fused | "xla"=
     # pure-XLA blockwise online-softmax (long-seq configs; "xla" is the
     # Mosaic-free safety net)
     flash_blocks: Optional[tuple] = None  # (block_q, block_kv) kernel tuning

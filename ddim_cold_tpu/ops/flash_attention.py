@@ -340,6 +340,23 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
     return from_heads(dq), from_heads(dk), from_heads(dv)
 
 
+def online_softmax_update(o, l, m, logits, v_blk):
+    """One blockwise-softmax accumulation step — THE shared update used by
+    the pure-XLA blockwise path below and the ring-attention rotation steps
+    (parallel/ring_attention.py): fold a new logits block into the running
+    (output-numerator, denominator, max) triple, all f32.
+
+    Shapes: o ``(..., nq, D)``, l/m ``(..., nq)``, logits ``(..., nq, bkv)``,
+    v_blk ``(..., bkv, D)`` — leading dims broadcast (B, H, ...).
+    """
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
+    return o, l, m_new
+
+
 def blockwise_attention_xla(q, k, v, scale, block_kv: int = 512) -> jax.Array:
     """Pure-XLA blockwise softmax attention — the Mosaic-free middle path.
 
@@ -378,12 +395,7 @@ def blockwise_attention_xla(q, k, v, scale, block_kv: int = 512) -> jax.Array:
         k_b, v_b, val = blk
         logits = jnp.einsum("bhqd,bhkd->bhqk", qf, k_b) * scale
         logits = jnp.where(val[None, None, None, :], logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_b)
-        return (o, l, m_new), None
+        return online_softmax_update(o, l, m, logits, v_b), None
 
     (o, l, _), _ = jax.lax.scan(body, (o, l, m), (kb, vb, valid))
     return (o / l[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)

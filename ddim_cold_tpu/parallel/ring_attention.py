@@ -60,15 +60,13 @@ def ring_attention(
     qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B,H,nq,D)
 
     def accumulate(o, l, m, k_blk, v_blk, valid_blk):
+        from ddim_cold_tpu.ops.flash_attention import online_softmax_update
+
         logits = jnp.einsum("bhqd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
         logits = jnp.where(valid_blk[:, None, None, :], logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        return o, l, m_new
+        # v arrives (B, k, H, D); the shared update wants (B, H, k, D)
+        return online_softmax_update(
+            o, l, m, logits, v_blk.astype(jnp.float32).transpose(0, 2, 1, 3))
 
     def body(_, carry):
         o, l, m, k_blk, v_blk, valid_blk = carry

@@ -38,7 +38,7 @@ def ulysses_self_attention(
     axis: str = "seq",
     batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
-    use_flash: object = False,  # False | True (Pallas) | "xla" (blockwise)
+    use_flash: "bool | str" = False,  # False | True (Pallas) | "xla" (blockwise)
     flash_blocks: Optional[tuple] = None,
 ) -> jax.Array:
     """Global-array front end, mirror of ``ring_self_attention``.
