@@ -673,8 +673,11 @@ def _bench_e2e(args, model, state, log):
         # On a network-attached device, group steps_per_dispatch batches into
         # one transfer + one dispatch (lax.scan over the group): n× fewer
         # round trips and n× larger payloads — the two levers a thin host
-        # link responds to. Local backends keep spd=1 (nothing to amortize).
-        spd = 1 if jax.default_backend() == "cpu" else 8
+        # link responds to. Local backends keep spd=1 (nothing to amortize);
+        # the env override exists so the grouped loop is CPU-testable before
+        # it first runs on chip (tests/test_bench.py).
+        spd = (int(os.environ.get("DDIM_COLD_E2E_SPD", "0"))
+               or (1 if jax.default_backend() == "cpu" else 8))
         loader = ShardedLoader(ds, args.batch, shuffle=True, seed=42,
                                drop_last=True, raw=True)
         raw_step = make_train_step(

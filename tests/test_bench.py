@@ -133,6 +133,23 @@ def test_bench_e2e_section_runs_on_cpu():
         assert np.isfinite(row["value"]) and row["value"] > 0
         assert row["steps_per_dispatch"] == 1  # cpu backend: nothing to amortize
 
+    # the grouped loop (the accelerator default, spd=8 on chip) must also
+    # run before its first hardware execution — forced via the env override.
+    # Fresh state: the first call's train steps DONATED the old one's buffers.
+    import os
+
+    state2 = create_train_state(model, jax.random.PRNGKey(0), lr=2e-4,
+                                total_steps=100, sample_batch=batch)
+    os.environ["DDIM_COLD_E2E_SPD"] = "2"
+    try:
+        out2 = bench._bench_e2e(args, model, state2, lambda m: None)
+    finally:
+        del os.environ["DDIM_COLD_E2E_SPD"]
+    for label in ("cold", "warm"):
+        row = out2[f"e2e_train_throughput_{label}"]
+        assert np.isfinite(row["value"]) and row["value"] > 0
+        assert row["steps_per_dispatch"] == 2
+
 
 def test_bench_fatal_error_still_emits_partial_record():
     """An exception escaping the try body (here: a headline failure forced by
