@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Render a bench record (results/bench_r*_tpu.json or BENCH_r*.json) into
+the PERF.md-style markdown tables — so the write-up after an evidence drop
+is a paste, not a transcription (and transcription errors can't creep into
+the round's perf claims).
+
+Usage: python scripts/perf_tables.py [record.json ...]
+Defaults to the newest results/bench_r*_tpu.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddim_cold_tpu.utils.record import last_json_record  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def render(path: str) -> str:
+    rec = last_json_record(path)
+    if rec is None:
+        return f"<!-- {path}: no parseable record -->"
+    sub = rec.get("submetrics", {})
+    lines = [f"### {os.path.relpath(path, REPO)}", ""]
+    lines += [f"chip: **{rec.get('chip')}** · headline "
+              f"**{rec.get('value')} img/s** @ b32 "
+              f"({rec.get('vs_baseline')}× the 702 img/s 3090 baseline) · "
+              f"{rec.get('ms_per_step')} ms/step · MFU {rec.get('mfu')}", ""]
+    if rec.get("captured_earlier"):
+        ce = sub.get("captured_earlier", {})
+        lines += [f"> REUSED record ({ce.get('file')}"
+                  + (f", stale round {ce['stale_round']}" if "stale_round" in ce
+                     else "") + ") — not a fresh measurement", ""]
+
+    rows = sub.get("batch_scaling")
+    if rows:
+        lines += ["| batch | ms/step | img/s | MFU |", "|---|---|---|---|"]
+        for r in rows:
+            mfu = r.get("mfu")
+            lines.append(f"| {r['batch']} | {r['ms_per_step']} | "
+                         f"{r['img_per_sec']} | "
+                         f"{'' if mfu is None else f'{100 * mfu:.1f}%'} |")
+        lines.append("")
+
+    for name in ("scan_blocks", "remat"):
+        r = sub.get(name)
+        if r:
+            plain = r.get("plain_ms_per_step",
+                          r.get("unrolled_ms_per_step"))  # pre-r04 key name
+            lines.append(
+                f"* **{name}** b{r['batch']}: {r['ms_per_step']} ms/step "
+                f"(compile {r['compile_s']}s) vs plain {plain} ms/step"
+                + (f", MFU {100 * r['mfu']:.1f}%" if r.get("mfu") else ""))
+
+    ns = {s: sub.get("sampler_throughput_200px_k20" + s)
+          for s in ("", "_dense", "_flash", "_xla", "_flash_n64")}
+    if any(ns.values()):
+        lines.append("")
+        lines.append("**200px k=20 north-star (img/s/chip):** "
+                     + " · ".join(f"{(s or '_best')[1:]}={v['value']}"
+                                  for s, v in ns.items() if v))
+    sweep = sub.get("northstar_flash_block_sweep")
+    if sweep:
+        lines.append("flash block sweep: "
+                     + " · ".join(f"{k}→{v}" for k, v in sweep.items()))
+    for key in ("northstar_error", "northstar_flash_error",
+                "northstar_dense_error", "northstar_xla_error",
+                "northstar_n64_error"):
+        if key in sub:
+            lines.append(f"`{key}`: {sub[key]}")
+
+    ks = sub.get("ksweep_64px_img_per_sec")
+    if ks:
+        lines.append("")
+        lines.append("**k-sweep 64px (img/s):** "
+                     + " · ".join(f"k={k}: {v}" for k, v in ks.items()))
+    e2e = [(lbl, sub.get(f"e2e_train_throughput_{lbl}"))
+           for lbl in ("cold", "warm")]
+    if any(v for _, v in e2e):
+        bw = sub.get("h2d_bandwidth_mib_s")
+        lines.append("")
+        lines.append("**e2e disk→step (img/s):** " + " · ".join(
+            f"{lbl}={v['value']} ({v['vs_baseline']}×"
+            + (f", spd={v['steps_per_dispatch']}" if "steps_per_dispatch" in v
+               else "") + ")"
+            for lbl, v in e2e if v)
+            + (f" · H2D link ≈ {bw} MiB/s" if bw else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    paths = (argv or sys.argv)[1:]
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(REPO, "results", "bench_r*_tpu.json")))[-1:]
+        if not paths:
+            print("no bench records found", file=sys.stderr)
+            return 1
+    for p in paths:
+        print(render(p))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
