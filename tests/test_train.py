@@ -327,6 +327,53 @@ def test_steps_per_dispatch_trainer_run(tmp_path, synthetic_image_dir):
     assert "steps:        2 " in text and "steps:        4 " in text
 
 
+def test_steps_per_dispatch_composes_with_grad_accum_and_ema():
+    """spd=2 × grad_accum=2 × ema_decay: the scanned dispatch must equal two
+    sequential accumulated steps, EMA shadow included (nested lax.scans plus
+    the optimizer-tail EMA update all advance correctly inside the outer
+    scan)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32,
+                         depth=1, num_heads=2)
+    r = np.random.RandomState(1)
+    batches = [
+        (jnp.asarray(r.randn(4, 16, 16, 3), jnp.float32),
+         jnp.asarray(r.randn(4, 16, 16, 3), jnp.float32),
+         jnp.asarray(r.randint(1, 7, size=(4,)), jnp.int32))
+        for _ in range(2)
+    ]
+    mk = lambda: create_train_state(  # noqa: E731
+        model, jax.random.PRNGKey(0), lr=1e-3, total_steps=100,
+        sample_batch=batches[0], ema_decay=0.9)
+    rng = jax.random.PRNGKey(2)
+
+    seq_state = mk()
+    one = make_train_step(model, grad_accum=2, ema_decay=0.9)
+    rec = jnp.float32(5.0)
+    for b in batches:
+        seq_state, _, rec = one(seq_state, b, rng, rec)
+
+    multi_state = mk()
+    multi = make_train_step(model, grad_accum=2, ema_decay=0.9,
+                            steps_per_dispatch=2)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    multi_state, _, mrec = multi(multi_state, stacked, rng, jnp.float32(5.0))
+
+    assert float(mrec) == pytest.approx(float(rec), rel=1e-5)
+    for tree_a, tree_b in ((multi_state.params, seq_state.params),
+                           (multi_state.ema_params, seq_state.ema_params)):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            tree_a, tree_b)
+    assert int(multi_state.step) == int(seq_state.step) == 2
+
+
 def test_steps_per_dispatch_validation(tmp_path, synthetic_image_dir):
     with pytest.raises(ValueError, match="steps_per_dispatch"):
         load_config(_write_config(str(tmp_path), synthetic_image_dir,
