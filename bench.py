@@ -356,6 +356,16 @@ def main(argv=None):
                 best = min(best, (time.time() - t0) / per)
             return st, best, compile_s
 
+        def emit_snapshot():
+            """Print the record as it stands (consumers — the driver, the
+            reuse fallback, the chain oracle — all take the LAST parseable
+            line, so intermediate snapshots are strictly additive). An
+            externally-killed healthy run (a driver timeout shorter than the
+            full bench) then still leaves everything measured so far on
+            stdout; the stall watchdog only covers wedges, not kills."""
+            print(json.dumps(record))
+            sys.stdout.flush()
+
         state, spi, compile_s = time_train(state, batch, args.steps)
         img_per_sec = B / spi
         step_flops = flops_util.train_step_flops(
@@ -366,6 +376,7 @@ def main(argv=None):
             vs_baseline=round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
             ms_per_step=round(1000 * spi, 3),
             mfu=None if train_mfu is None else round(train_mfu, 4))
+        emit_snapshot()  # the headline survives even an early external kill
         log(f"platform={jax.default_backend()} chip={chip!r} "
             f"peak_bf16={peak} TFLOP/s compile={compile_s:.1f}s "
             f"{args.steps} steps @ b{B}: {1000*spi:.2f} ms/step "
@@ -389,11 +400,13 @@ def main(argv=None):
                 try:
                     fn()
                     sub.pop(name + "_error", None)  # clean record if retry healed
+                    emit_snapshot()  # each finished section lands on stdout
                     return
                 except Exception as e:  # noqa: BLE001 — deliberate catch-all
                     log(f"{name} section failed (attempt {attempt + 1}): "
                         f"{type(e).__name__}: {e}")
                     sub[name + "_error"] = f"{type(e).__name__}: {e}"
+                    emit_snapshot()  # the error note survives a later kill
 
         # --------------------------------------------------------- batch scaling
         scaling_rows = {}  # per-batch memo: a section retry redoes only the tail
