@@ -90,15 +90,23 @@ t200() {
 run_stage train200 "200px flash training" t200
 
 # incomplete stages (tunnel died mid-chain)? re-arm the watcher, bounded.
+# Re-arm target is the REPO-OWNED script itself (ADVICE r4 medium: a /tmp
+# path is both wiped by re-imaging and pre-creatable by other local users
+# on a shared host), and the chain refuses to arm a missing target.
+SELF="$(pwd)/scripts/recover_evidence_r04.sh"
 INCOMPLETE=0
 for s in northstar validate fullbench train200; do
   python scripts/r04_stage_done.py "$s" || INCOMPLETE=1
 done
 if [ "$INCOMPLETE" = 1 ] && [ "$A" -lt 5 ]; then
-  note "stages incomplete — re-arming watch_tpu (attempt $A/5)"
-  nohup python scripts/watch_tpu.py --interval 180 --timeout 90 \
-    --log results/watch_tpu_r04.log --once-exec 'bash /tmp/finish_chain.sh' \
-    >/dev/null 2>&1 &
+  if [ ! -f "$SELF" ]; then
+    note "re-arm ABORTED: exec target $SELF missing"
+  else
+    note "stages incomplete — re-arming watch_tpu (attempt $A/5)"
+    nohup python scripts/watch_tpu.py --interval 180 --timeout 90 \
+      --log results/watch_tpu_r04.log --once-exec "bash $SELF" \
+      >/dev/null 2>&1 &
+  fi
 elif [ "$INCOMPLETE" = 1 ]; then
   note "stages incomplete but attempt budget exhausted ($A) — not re-arming"
 else

@@ -1,0 +1,155 @@
+#!/usr/bin/env bash
+# Round-5 evidence chain, fired on TPU-tunnel recovery (watch_tpu --once-exec).
+#
+# Ordering is VERDICT r4's: the flash 200px north-star FIRST (pending three
+# rounds — run it before anything that could wedge the tunnel), then on-chip
+# flash numerics, then the full bench (scaling to b1024 + remat row + e2e
+# with steps-per-dispatch + compile cache), then the 200px flash training
+# run (flash BACKWARD on hardware), then the 200px zero-shot apps from the
+# fresh weights (VERDICT r4 item 8). Every stage commits its evidence the
+# moment it lands (hosts re-image between sessions; uncommitted evidence
+# dies) and is idempotent via scripts/r05_stage_done.py, so a re-fired chain
+# never re-burns chip time.
+#
+# No `timeout` wrappers anywhere: SIGTERM/SIGKILL on a client that holds the
+# chip grant is what wedges the tunnel in the first place (utils/platform.py).
+# bench.py bounds itself with its stall watchdog (partial record + exit 3).
+set -u
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+mkdir -p results
+LOG=results/recovery_chain.log
+note() { echo "$(date '+%F %T') [chain-r05] $*" | tee -a "$LOG"; }
+
+# bench round-provenance override: the chain KNOWS which round it serves, so
+# bench never has to infer it from BENCH_r*.json mtimes (ADVICE r4 low #2)
+export DDIM_COLD_ROUND=5
+
+ATTEMPTS_F=results/.r05_chain_attempts
+A=$(cat "$ATTEMPTS_F" 2>/dev/null || echo 0); A=$((A+1)); echo "$A" > "$ATTEMPTS_F"
+note "=== r05 chain start (pid $$, attempt $A) ==="
+
+commit_evidence() { # $1 = message
+  git add -A results/ >>"$LOG" 2>&1
+  if ! git diff --cached --quiet; then
+    # identity fallback: a re-imaged host may lose git config — evidence
+    # must still commit, authored like the repo's existing history
+    local -a idargs=()
+    if ! git config user.email >/dev/null 2>&1; then
+      idargs=(-c "user.name=$(git log -1 --format='%an')" \
+              -c "user.email=$(git log -1 --format='%ae')")
+    fi
+    if git "${idargs[@]}" commit -q -m "$1" -m "No-Verification-Needed: evidence-only capture (results/ artifacts, no source change)" >>"$LOG" 2>&1; then
+      note "committed: $1"
+    else
+      note "commit FAILED: $1"
+    fi
+  fi
+}
+
+run_stage() { # $1 = stage key, $2 = label, $3... = command
+  local key=$1 label=$2; shift 2
+  if python scripts/r05_stage_done.py "$key"; then
+    note "$label: SKIPPED (evidence already present)"
+    return 0
+  fi
+  note "$label: start"
+  if "$@" >>"$LOG" 2>&1; then
+    note "$label: OK"
+  else
+    note "$label: FAILED rc=$?"
+  fi
+  commit_evidence "Evidence: r05 $label"
+}
+
+# stage 0 — the north-star flash/dense/xla 200px sampler record (+ block
+# sweep). Three rounds pending; it runs before anything that could wedge.
+ns() {
+  python bench.py --skip-e2e --skip-scaling --skip-sampler --no-ksweep \
+    --flash-block-sweep --no-reuse \
+    > results/bench_r05_northstar.json 2> results/bench_r05_northstar.log
+}
+run_stage northstar "north-star bench" ns
+
+# stage 1 — on-chip flash fwd numerics (the Mosaic fix is CPU-guarded only)
+val() { python scripts/tpu_validate.py --no-bench > results/tpu_validate_r05.txt 2>&1; }
+run_stage validate "tpu_validate numerics" val
+
+# stage 2 — the full round-5 bench record (scaling→b1024, remat, e2e+spd,
+# b64 re-measure with the two-window timer)
+fb() {
+  python bench.py --no-reuse > results/bench_r05_tpu.json 2> results/bench_r05_tpu.log
+}
+run_stage fullbench "full bench" fb
+
+# stage 3 — the 200px flash training run (flash BACKWARD on hardware) +
+# published run dir + snapshot FID trend
+t200() {
+  if [ ! -d OxfordFlowers200/train ] || [ ! -d OxfordFlowers200/val ]; then
+    note "generating OxfordFlowers200 (4096 train / 512 val @ 200px)"
+    python scripts/make_dataset.py --out OxfordFlowers200 --size 200 \
+      --train 4096 --val 512 || return $?
+  fi
+  python multi_gpu_trainer.py 20220822_200px || return $?
+  python scripts/publish_run.py Saved_Models/20220822_200pxflower200_diffusion || return $?
+  python scripts/fid_trend.py Saved_Models/20220822_200pxflower200_diffusion \
+    || note "fid_trend FAILED rc=$? (best-effort)"
+  return 0
+}
+run_stage train200 "200px flash training" t200
+
+# stage 4 — 200px zero-shot apps from the fresh stage-3 weights (VERDICT r4
+# item 8): draft2drawing restart grid + slerp interpolation, published.
+a200() {
+  local run=Saved_Models/20220822_200pxflower200_diffusion
+  local ck=""
+  for c in "$run/bestloss.ckpt" "$run/bestloss.pkl" "$run/lastepoch.ckpt"; do
+    [ -e "$c" ] && { ck=$c; break; }
+  done
+  if [ -z "$ck" ]; then
+    note "apps200: no 200px checkpoint found (stage 3 incomplete?)"; return 1
+  fi
+  # draft + interpolation endpoints from the val split (any three images)
+  local imgs
+  imgs=$(ls OxfordFlowers200/val/*.jpg 2>/dev/null | head -3)
+  set -- $imgs
+  [ $# -ge 3 ] || { note "apps200: <3 val images available"; return 1; }
+  python ViT_draft2drawing.py --config oxford_flower_200_p4 \
+    --checkpoint "$ck" --draft "$1" --interpolate "$2" "$3" --cold-n 4 \
+    >> "$LOG" 2>&1 || return $?
+  mkdir -p results/20220822_200pxflower200_diffusion
+  # get_next_path suffixes repeats; take the newest of each artifact family
+  for base in draft2img interpolation cold_samples cold_sequence; do
+    local latest
+    latest=$(ls -t Saved_Models/${base}*.png 2>/dev/null | head -1)
+    [ -n "$latest" ] && cp "$latest" \
+      "results/20220822_200pxflower200_diffusion/${base}.png"
+  done
+  return 0
+}
+run_stage apps200 "200px zero-shot apps" a200
+
+# incomplete stages (tunnel died mid-chain)? re-arm the watcher, bounded.
+# Re-arm target is the REPO-OWNED script path (ADVICE r4 medium: a /tmp
+# path is both wiped by re-imaging and pre-creatable by other local users
+# on a shared host), and the chain refuses to arm a missing target.
+SELF="$REPO/scripts/recover_evidence_r05.sh"
+INCOMPLETE=0
+for s in northstar validate fullbench train200 apps200; do
+  python scripts/r05_stage_done.py "$s" || INCOMPLETE=1
+done
+if [ "$INCOMPLETE" = 1 ] && [ "$A" -lt 5 ]; then
+  if [ ! -f "$SELF" ]; then
+    note "re-arm ABORTED: exec target $SELF missing"
+  else
+    note "stages incomplete — re-arming watch_tpu (attempt $A/5)"
+    nohup python scripts/watch_tpu.py --interval 180 --timeout 90 \
+      --log results/watch_tpu_r05.log --once-exec "bash $SELF" \
+      >/dev/null 2>&1 &
+  fi
+elif [ "$INCOMPLETE" = 1 ]; then
+  note "stages incomplete but attempt budget exhausted ($A) — not re-arming"
+else
+  note "ALL STAGES DONE"
+fi
+note "=== r05 chain end (attempt $A) ==="
