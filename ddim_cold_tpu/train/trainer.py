@@ -452,6 +452,20 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                                       n_microbatch=n_micro)
     state = shard_train_state(state, mesh, specs)
     spd = config.steps_per_dispatch
+    if (max_steps is not None and spd > 1 and max_steps > steps
+            and (max_steps - steps) % spd):
+        # the loop advances `steps` in whole dispatches of spd optimizer
+        # steps (one compiled lax.scan), so a bound not reachable in whole
+        # dispatches FROM THE (possibly resumed) START STEP would silently
+        # run up to spd-1 steps past max_steps — and the cosine schedule/
+        # checkpoint counters would include them (ADVICE r4). A bench/test
+        # comparing against a step-bounded baseline must get the exact step
+        # count it asked for, so fail loud instead of rounding.
+        raise ValueError(
+            f"max_steps={max_steps} is not reachable in whole dispatches of "
+            f"steps_per_dispatch={spd} from start step {steps}; the dispatch "
+            "granularity makes the bound inexact — use a compatible bound, "
+            "or steps_per_dispatch=1")
     train_step = make_train_step(
         model, apply_fn, prepare=prepare,
         ema_decay=config.ema_decay, grad_accum=config.grad_accum,

@@ -487,6 +487,29 @@ def test_profile_steps_writes_trace(tmp_path, synthetic_image_dir):
     assert any(f for _, _, fs in os.walk(trace_dir) for f in fs), "empty trace"
 
 
+def test_steps_per_dispatch_rejects_indivisible_max_steps(tmp_path,
+                                                          synthetic_image_dir):
+    """max_steps not a multiple of steps_per_dispatch fails loud (ADVICE r4):
+    the loop advances in whole spd-dispatches, so a non-divisible bound would
+    silently run up to spd-1 optimizer steps past max_steps — and the cosine
+    schedule/checkpoint counters would include them."""
+    from ddim_cold_tpu.config import ExperimentConfig
+    from ddim_cold_tpu.train.trainer import run
+
+    cfg = ExperimentConfig(
+        exp_name="spd_guard", framework="t", batch_size=2, epoch=(0, 1),
+        base_lr=0.005, data_storage=(synthetic_image_dir, synthetic_image_dir),
+        image_size=(16, 16), patch_size=8, embed_dim=32, depth=1, head=2,
+        steps_per_dispatch=2,
+    )
+    with pytest.raises(ValueError, match="not reachable in whole dispatches"):
+        run(cfg, str(tmp_path), max_steps=3)
+    # divisible bound: exact — the run stops at precisely max_steps
+    result = run(cfg, str(tmp_path), max_steps=4)
+    assert np.isfinite(result.best_loss)
+    assert result.steps == 4
+
+
 def test_ema_step_math():
     """ema_decay>0: the shadow follows ema ← d·ema + (1−d)·p exactly, seeded
     from the init params; off (0): ema_params stays None and the step is the
