@@ -1,26 +1,60 @@
-"""Worker for test_multihost.py — one simulated host in a 2-process run.
+"""Worker for test_multihost.py — one simulated host in an N-process run.
 
-Run as: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <out_dir>
+Run as: python _multihost_worker.py <coordinator> <num_procs> <proc_id> \
+            <out_dir> [mode]
 
-Each process gets 4 virtual CPU devices (xla_force_host_platform_device_count,
+Each process gets its virtual CPU devices (xla_force_host_platform_device_count,
 set by the parent), initializes `jax.distributed` over the local coordinator
-(the DCN-rendezvous path, parallel/mesh.py:28-36), builds an 8-device global
-mesh, feeds its process-local half of the global batch through
-``shard_batch`` (make_array_from_process_local_data — the multi-host branch,
-parallel/mesh.py:74-77), runs one train step, and participates in a
-collective orbax save (train/trainer.py save path). Writes the loss it saw to
+(the DCN-rendezvous path, parallel/mesh.py:28-36), builds a global mesh,
+feeds its process-local shard of the global batch through ``shard_batch``
+(make_array_from_process_local_data — the multi-host branch,
+parallel/mesh.py:74-77), runs one train step, and writes the loss it saw to
 ``<out_dir>/loss_<proc_id>.txt`` for the parent to compare.
+
+Modes:
+
+* ``dp`` (default) — pure data-parallel over all devices, plus a grouped
+  steps_per_dispatch=2 step and a collective orbax save (the 2-process
+  matrix entry);
+* ``dptpsp`` — the composed {data, model, seq} mesh: tensor-parallel params
+  over 'model', ring attention over 'seq', grouped steps_per_dispatch
+  dispatch — the layout the virtual-mesh dryrun compiles, here under REAL
+  processes over DCN (VERDICT r4 item 7). Two processes share each data
+  shard, so the worker derives its shard index from its addressable
+  devices' mesh coordinates rather than from proc_id.
 """
 
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def data_shard_bounds(mesh, batch_rows: int) -> tuple[int, int]:
+    """[lo, hi) rows of the global batch held by THIS process, from the mesh
+    coordinates of its addressable devices along 'data' (the general form of
+    the 2-proc test's proc_id*rows slicing — correct even when several
+    processes replicate one data shard across 'model'/'seq')."""
+    axis = list(mesh.axis_names).index("data")
+    coords = {
+        int(np.argwhere(np.asarray(mesh.devices) == d)[0][axis])
+        for d in mesh.local_devices
+    }
+    assert len(coords) == 1, (
+        f"process spans data shards {sorted(coords)} — the P('data') batch "
+        "contract needs each process inside one shard")
+    n = int(mesh.shape["data"])
+    rows = batch_rows // n
+    lo = coords.pop() * rows
+    return lo, lo + rows
 
 
 def main():
     coordinator, num_procs, proc_id, out_dir = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
 
     import jax
 
@@ -35,14 +69,18 @@ def main():
 
     initialize_distributed(coordinator, num_procs, proc_id)
     assert jax.process_count() == num_procs, jax.process_count()
-    assert jax.local_device_count() == 4, jax.local_device_count()
 
     import jax.numpy as jnp
-    import numpy as np
 
     from ddim_cold_tpu.models import DiffusionViT
     from ddim_cold_tpu.train.step import create_train_state, make_train_step
     from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    if mode == "dptpsp":
+        run_dptpsp(jax, jnp, out_dir, proc_id)
+        jax.distributed.shutdown()
+        return
+    assert jax.local_device_count() == 4, jax.local_device_count()
 
     mesh = make_mesh({"data": jax.device_count()})
 
@@ -85,6 +123,68 @@ def main():
     with open(os.path.join(out_dir, f"loss_{proc_id}.txt"), "w") as f:
         f.write(repr(loss))
     jax.distributed.shutdown()
+
+
+def run_dptpsp(jax, jnp, out_dir: str, proc_id: int):
+    """The composed {data:2, model:2, seq:2} layout under REAL processes
+    (VERDICT r4 item 7): 4 processes × 2 local devices = 8 global devices —
+    tensor-parallel params over 'model' (param_partition_specs), ring
+    attention over 'seq', and ONE grouped steps_per_dispatch=2 dispatch.
+    Mirrors __graft_entry__.dryrun_multichip's dp×tp×sp recipe, swapping the
+    virtual single-process mesh for a DCN-rendezvoused one."""
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.ops import degrade
+    from ddim_cold_tpu.parallel import (
+        make_mesh, param_partition_specs, shard_batch, shard_train_state,
+    )
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    assert jax.local_device_count() == 2, jax.local_device_count()
+    mesh = make_mesh({"data": 2, "model": 2, "seq": 2})
+
+    model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32,
+                         depth=2, num_heads=4, total_steps=10,
+                         seq_mesh=mesh, seq_axis="seq", batch_axis="data",
+                         head_axis="model", attn_drop_rate=0.0)
+    # deterministic global batch; THIS process's rows come from its
+    # addressable devices' 'data' coordinate (two processes per shard here —
+    # proc_id arithmetic from the dp worker would be wrong)
+    rng = np.random.RandomState(0)
+    B = 8
+    gu = rng.randint(0, 256, size=(B, 16, 16, 3)).astype(np.uint8)
+    gt = rng.randint(1, 5, size=(B,)).astype(np.int32)
+    lo, hi = data_shard_bounds(mesh, B)
+    local = (gu[lo:hi], gt[lo:hi])
+
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), lr=1e-3, total_steps=10,
+        sample_batch=(np.zeros((2, 16, 16, 3), np.float32),
+                      np.zeros((2, 16, 16, 3), np.float32),
+                      np.ones((2,), np.int32)))
+    state = shard_train_state(state, mesh,
+                              param_partition_specs(state.params))
+    prepare = degrade.make_cold_prepare(size=16, max_step=4, chain=True,
+                                        mesh=mesh)
+    step = make_train_step(model, prepare=prepare)
+    batch = shard_batch(local, mesh)
+    assert not batch[0].is_fully_addressable
+    state, loss, _ = step(state, batch, jax.random.PRNGKey(1),
+                          jnp.float32(5.0))
+    loss = float(loss)
+    assert np.isfinite(loss), loss
+
+    # grouped dispatch: 2 stacked optimizer steps, scan axis unsharded,
+    # 'data' on the per-step batch dim — under real processes
+    g_step = make_train_step(model, prepare=prepare, steps_per_dispatch=2)
+    grouped = tuple(np.stack([a, a]) for a in local)
+    gbatch = shard_batch(grouped, mesh, grouped=True)
+    assert not gbatch[0].is_fully_addressable
+    state, gloss, _ = g_step(state, gbatch, jax.random.PRNGKey(1),
+                             jnp.float32(5.0))
+    assert np.isfinite(float(gloss)), gloss
+
+    with open(os.path.join(out_dir, f"loss_{proc_id}.txt"), "w") as f:
+        f.write(repr(loss))
 
 
 if __name__ == "__main__":

@@ -23,12 +23,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_train_step(tmp_path):
+def _spawn_workers(tmp_path, n_procs: int, local_devices: int, mode: str,
+                   timeout: float):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devices}",
         JAX_ENABLE_X64="0",
         # share the suite's persistent compile cache (conftest.py) so rerun
         # workers skip their XLA compiles
@@ -37,25 +38,46 @@ def test_two_process_distributed_train_step(tmp_path):
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coordinator, "2", str(r), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)
+            [sys.executable, WORKER, coordinator, str(n_procs), str(r),
+             str(tmp_path), mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for r in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
             p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
-
     losses = []
-    for r in range(2):
+    for r in range(n_procs):
         with open(tmp_path / f"loss_{r}.txt") as f:
             losses.append(float(f.read()))
+    return losses
+
+
+def test_four_process_dp_tp_sp_grouped_step(tmp_path):
+    """The composed dp×tp×sp layout under REAL DCN processes (VERDICT r4
+    item 7): 4 processes × 2 virtual devices = {data:2, model:2, seq:2} —
+    tensor-parallel params, ring attention over 'seq', and a grouped
+    steps_per_dispatch=2 dispatch. Everything beyond 2 processes previously
+    ran only on single-process virtual meshes."""
+    losses = _spawn_workers(tmp_path, n_procs=4, local_devices=2,
+                            mode="dptpsp", timeout=600)
+    # gradient psum ⇒ one global-mean loss, identical on every process —
+    # including the two process pairs that REPLICATE each data shard
+    assert len(set(losses)) == 1, losses
+    assert 0.0 < losses[0] < 10.0
+
+
+def test_two_process_distributed_train_step(tmp_path):
+    losses = _spawn_workers(tmp_path, n_procs=2, local_devices=4, mode="dp",
+                            timeout=240)
     # the gradient psum makes the loss a global mean — identical across hosts
     assert losses[0] == losses[1]
     assert 0.0 < losses[0] < 10.0
