@@ -250,10 +250,22 @@ class _BaseCache:
 
 
 def pil_loader(path: str) -> Image.Image:
-    """Open an image file and force RGB (reference diffusion_loader.py:17-21)."""
+    """Open an image file and force RGB (reference diffusion_loader.py:17-21).
+
+    PIL is the LAST decode tier (native rejects route here), so its failures
+    are terminal: re-raise with the offending path attached — a
+    DecompressionBombError or truncated-file error naming only an internal
+    buffer is undebuggable mid-epoch over a million-file dataset."""
     with open(path, "rb") as f:
-        img = Image.open(f)
-        return img.convert("RGB")
+        try:
+            img = Image.open(f)
+            return img.convert("RGB")
+        except Exception as e:
+            # prepend the path in-place: constructing type(e) from a bare
+            # string is not a safe contract across exception classes
+            e.args = (f"{path}: " + (str(e.args[0]) if e.args else repr(e)),
+                      *e.args[1:])
+            raise
 
 
 def _list_images(root: str, hint_size: int = 64) -> list[str]:

@@ -49,6 +49,16 @@ void jpeg_error_exit(j_common_ptr cinfo) {
 
 void jpeg_silent(j_common_ptr, int) {}
 
+// Decompression-bomb cap: a 4 KB file whose header claims 65535x65535 would
+// otherwise commit a ~12.9 GB buffer which libjpeg's premature-EOF padding
+// then touches page by page. Set to exactly 2x PIL's MAX_IMAGE_PIXELS — the
+// threshold where PIL escalates its DecompressionBombWarning to an error —
+// so no image the PIL tier would accept ever loses native acceleration, and
+// every file this cap rejects is one the PIL fallback refuses too
+// (DecompressionBombError, surfaced with the offending path by
+// data/datasets.py pil_loader).
+constexpr size_t kMaxPixels = 2 * 89478485ull;
+
 // Decode a JPEG file to RGB8. Returns nullptr on any decode error (caller
 // falls back to the PIL path). Defaults (islow DCT, fancy upsampling) match
 // PIL's, which wraps the same libjpeg.
@@ -74,6 +84,11 @@ uint8_t* decode_jpeg(FILE* f, int* out_h, int* out_w) {
   const int h = cinfo.output_height, w = cinfo.output_width;
   const int c = cinfo.output_components;
   if (c != 3) {  // out_color_space=JCS_RGB should guarantee 3
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  if (h <= 0 || w <= 0 ||
+      static_cast<size_t>(h) * static_cast<size_t>(w) > kMaxPixels) {
     jpeg_destroy_decompress(&cinfo);
     return nullptr;
   }
@@ -108,6 +123,11 @@ uint8_t* decode_png(FILE* f, int* out_h, int* out_w) {
     return nullptr;
   }
   image.format = PNG_FORMAT_RGB;
+  if (image.height == 0 || image.width == 0 ||
+      static_cast<size_t>(image.height) * image.width > kMaxPixels) {
+    png_image_free(&image);
+    return nullptr;
+  }
   const size_t sz = PNG_IMAGE_SIZE(image);
   uint8_t* buf = static_cast<uint8_t*>(std::malloc(sz));
   if (!buf) {

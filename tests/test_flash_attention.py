@@ -107,6 +107,30 @@ def test_flash_gradient_blocked_matches_dense(N, bq, bkv):
                                    rtol=1e-4, atol=1e-5, err_msg=f"d{name}")
 
 
+def test_flash_gradient_north_star_shape_matches_dense():
+    """The Pallas BACKWARD at the exact north-star shape — N=2501 tokens
+    (200px, patch 4, +1 time token), H=4, D=64, production default blocks —
+    against autodiff through the dense einsum (VERDICT r4 item 9: forward
+    was exercised at this length, the 200px training stage runs the
+    backward, and Mosaic has rejected this kernel family on hardware once;
+    interpret mode proves the math, the tile-rule guard below covers the
+    lowering constraints)."""
+    q, k, v = _rand_qkv(13, 1, 2501, 4, 64)
+    scale = 64**-0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, scale) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_attention_f32(q, k, v, scale)[1] ** 2)
+
+    g_ours = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, ours, want in zip("qkv", g_ours, g_want):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
 def test_model_use_flash_parity():
     """DiffusionViT(use_flash=True) ≡ the einsum model in eval mode — same
     params tree (flash adds no parameters), same outputs."""
