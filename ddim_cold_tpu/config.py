@@ -30,7 +30,8 @@ and ``nan_checks`` (``jax_debug_nans`` for the run). A ``seq`` axis in
 ``mesh`` (e.g. ``{data: 4, seq: 2}``) turns on sequence
 parallelism — ``sp_mode`` selects the strategy: ``ring`` (K/V rotation,
 default, parallel/ring_attention.py) or ``ulysses`` (all-to-all head
-resharding, parallel/ulysses.py; heads must divide the seq axis)
+resharding, parallel/ulysses.py; local heads — num_heads over any tp
+axis — must divide the seq axis)
 parallelism (parallel/ring_attention.py); a ``pipe`` axis (with optional
 ``microbatches``) turns on GPipe pipeline parallelism over the stacked
 ``scan_blocks`` layout (parallel/pipeline.py).

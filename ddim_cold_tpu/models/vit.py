@@ -178,14 +178,12 @@ class Attention(nn.Module):
             if self.sp_mode == "ulysses":
                 from ddim_cold_tpu.parallel.ulysses import ulysses_self_attention
 
-                if self.head_axis is not None:
-                    raise ValueError(
-                        "ulysses sp shards heads over the seq axis itself — "
-                        "it cannot compose with tensor-parallel head "
-                        "sharding; use sp_mode='ring' on tp×sp meshes")
+                # tp composition: the all-to-all splits each tp group's
+                # LOCAL heads over the seq axis (ulysses.py head_axis)
                 out = ulysses_self_attention(
                     q, k, v, self.seq_mesh,
                     axis=self.seq_axis, batch_axis=self.batch_axis,
+                    head_axis=self.head_axis,
                     scale=scale, use_flash=self.use_flash,
                     flash_blocks=self.flash_blocks,
                 ).astype(self.dtype)

