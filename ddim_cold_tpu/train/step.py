@@ -152,7 +152,12 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
                     rngs={"dropout": drop_rng}, mutable=["losses"],
                 )
                 sown = jax.tree.leaves(aux_vars.get("losses", {}))
-                aux = (sum(jnp.sum(s) for s in sown) / len(sown)
+                # mean over LAYERS, layout-independent: the unrolled model
+                # sows depth scalar leaves, the scan_blocks layout ONE
+                # (depth,)-stacked leaf — normalize by total element count,
+                # not leaf count, so both layouts weight the aux identically
+                n_vals = sum(s.size for s in sown)
+                aux = (sum(jnp.sum(s) for s in sown) / n_vals
                        if sown else 0.0)
                 return smooth_l1(pred, target) + moe_aux_weight * aux
             pred = apply_fn(

@@ -210,8 +210,10 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     if config.num_experts > 1 and "pipe" in mesh_shape:
         raise ValueError(
             "num_experts > 1 does not compose with pipeline parallelism "
-            "(the pipe substrate is scan_blocks, which drops the MoE aux "
-            "loss) — use an 'expert' (and 'data') mesh axis instead")
+            "(the pipeline executor applies the block template functionally "
+            "and drops sown collections, losing the MoE aux loss; plain "
+            "scan_blocks composes fine) — use an 'expert' (and 'data') "
+            "mesh axis instead")
     if "seq" in mesh_shape:
         # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
         # tp axis the ring keeps heads sharded over it (no qkv all-gather)

@@ -138,7 +138,9 @@ def test_expert_sharded_step_matches_single_device(dispatch):
 
 def test_moe_trainer_end_to_end(tmp_path, synthetic_image_dir):
     """yaml num_experts=2 trains, evaluates (sow no-op on the immutable
-    eval path), and checkpoints; scan_blocks/pipe composition is rejected."""
+    eval path), and checkpoints — in BOTH block layouts (scan_blocks
+    composition was previously rejected; the scan now stacks the sown aux
+    losses on the layer axis)."""
     from ddim_cold_tpu.config import load_config
     from ddim_cold_tpu.train.trainer import run
     from tests.test_train import _write_config
@@ -148,10 +150,76 @@ def test_moe_trainer_end_to_end(tmp_path, synthetic_image_dir):
     result = run(cfg, str(tmp_path), log_every=2)
     assert result.steps == 5 and np.isfinite(result.last_val_loss)
 
-    bad = load_config(_write_config(str(tmp_path), synthetic_image_dir,
-                                    num_experts=2, scan_blocks=True), "exp")
-    with pytest.raises(ValueError, match="scan_blocks"):
-        run(bad, str(tmp_path), log_every=2)
+    scanned = load_config(_write_config(str(tmp_path), synthetic_image_dir,
+                                        num_experts=2, scan_blocks=True,
+                                        epoch=[0, 1]), "exp")
+    result = run(scanned, str(tmp_path / "scan"), log_every=2)
+    assert result.steps == 5 and np.isfinite(result.last_val_loss)
+
+
+def test_moe_expert_sharding_in_scan_layout():
+    """Stacked scan_blocks MoE params are (depth, E, ...): the 'expert' spec
+    must land on dim 1, not the leading layer axis (sharding dim 0 splits
+    layers over the expert mesh — a crash whenever depth % E != 0, silently
+    wrong layout otherwise). End-to-end: shard a depth-3, E-2 model on a
+    {data, expert} mesh and take one finite step."""
+    from ddim_cold_tpu.parallel.mesh import make_mesh, shard_batch, shard_train_state
+    from ddim_cold_tpu.parallel.sharding import param_partition_specs
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    cfg = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=3,
+               num_heads=2, num_experts=2, scan_blocks=True)
+    model = DiffusionViT(**cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+    t = jnp.array([3, 500, 9, 77], jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), x, t)["params"]
+
+    specs = param_partition_specs(params, axes=("expert",))
+    # depth=3 is NOT divisible by E=2 — a dim-0 'expert' spec cannot even shard
+    for spec in jax.tree.leaves(specs["blocks"]["moe"],
+                                is_leaf=lambda s: not isinstance(s, dict)):
+        if "expert" in tuple(spec):
+            assert tuple(spec)[0] is None and tuple(spec)[1] == "expert", spec
+
+    mesh = make_mesh({"data": 4, "expert": 2})
+    batch = (x, x, t)
+    state = create_train_state(model, jax.random.PRNGKey(2), lr=1e-3,
+                               total_steps=10, sample_batch=batch)
+    state = shard_train_state(state, mesh, specs)
+    step = make_train_step(model, moe_aux_weight=0.01)
+    state, loss, _ = step(state, shard_batch(batch, mesh),
+                          jax.random.PRNGKey(3), jnp.float32(5.0))
+    assert np.isfinite(float(loss)), loss
+
+
+def test_moe_aux_loss_layout_parity():
+    """The Switch aux loss is identical (same params, same inputs) whether
+    the trunk is unrolled or nn.scan-stacked — the scan keeps the sown
+    'losses' collection on the layer axis, and the step normalizes by total
+    element count so both layouts weight it the same."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    cfg = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
+               num_heads=2, num_experts=2, drop_rate=0.0, attn_drop_rate=0.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+    t = jnp.array([3, 500, 9, 77], jnp.int32)
+    loop = DiffusionViT(**cfg)
+    scan = DiffusionViT(scan_blocks=True, **cfg)
+    params = loop.init(jax.random.PRNGKey(1), x, t)["params"]
+    stacked = ckpt.stack_block_params(params)
+
+    def total_aux(model, p):
+        out, aux_vars = model.apply({"params": p}, x, t, mutable=["losses"])
+        sown = jax.tree.leaves(aux_vars.get("losses", {}))
+        n = sum(s.size for s in sown)
+        return out, sum(jnp.sum(s) for s in sown) / n
+
+    out_a, aux_a = total_aux(loop, params)
+    out_b, aux_b = total_aux(scan, stacked)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_a),
+                               rtol=1e-5, atol=1e-6)
+    assert float(aux_a) > 0.0
+    np.testing.assert_allclose(float(aux_b), float(aux_a), rtol=1e-6)
 
 
 def test_expert_mesh_axis_validated(tmp_path, synthetic_image_dir):
