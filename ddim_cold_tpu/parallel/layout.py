@@ -24,7 +24,9 @@ def layout_for_mesh(model, mesh: Mesh, params, *,
     )
 
     if int(mesh.shape.get("pipe", 1)) > 1:
-        return (pipeline_param_specs(params),
+        tensor_axes = tuple(a for a in ("model",)
+                            if int(mesh.shape.get(a, 1)) > 1)
+        return (pipeline_param_specs(params, tensor_axes=tensor_axes),
                 make_pipelined_apply(model, mesh, n_microbatch=n_microbatch))
     shard_axes = tuple(a for a in ("model", "expert")
                        if int(mesh.shape.get(a, 1)) > 1)

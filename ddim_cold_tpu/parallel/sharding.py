@@ -70,13 +70,30 @@ def param_partition_specs(params, axes=("model", "expert")):
         lambda p, v: _spec_for(p, v, tuple(axes)), params)
 
 
-def pipeline_param_specs(params, axis: str = "pipe"):
+def pipeline_param_specs(params, axis: str = "pipe", tensor_axes=()):
     """Specs for pipeline parallelism: the stacked ``blocks`` subtree shards
     its leading layer axis over ``axis`` (each stage's device row owns its
     own blocks — grads and optimizer state stay stage-local); everything
-    outside the trunk (embeddings, norm, head) is replicated."""
-    def spec(path, _):
+    outside the trunk (embeddings, norm, head) is replicated.
+
+    ``tensor_axes`` composes tensor parallelism INSIDE each stage (e.g.
+    ``("model",)`` on a {data, pipe, model} mesh): block kernels get their
+    Megatron column/row split on the trailing dims on top of the leading
+    ``axis`` shard. The pipeline executor runs the stage body with the
+    tensor axes left in GSPMD auto mode (pipeline.py ``axis_names``), so
+    these specs are the only tp wiring needed — the block code is unchanged.
+    """
+    tensor_axes = tuple(tensor_axes)
+
+    def spec(path, value):
         names = [getattr(k, "key", str(k)) for k in path]
-        return P(axis) if names and names[0] == "blocks" else P()
+        if not (names and names[0] == "blocks"):
+            return P()
+        tail = ()
+        if tensor_axes:
+            # _spec_for's stacked-layout spec: leading layer axis + tensor
+            # split on the trailing dims — swap its leading None for `axis`
+            tail = tuple(_spec_for(path, value, tensor_axes))[1:]
+        return P(axis, *tail)
 
     return jax.tree_util.tree_map_with_path(spec, params)

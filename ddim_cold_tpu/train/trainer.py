@@ -202,10 +202,12 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     kwargs = dict(config.model_kwargs())
     mesh_shape = getattr(mesh, "shape", {}) if mesh is not None else {}
     if "pipe" in mesh_shape:
-        if "model" in mesh_shape or "seq" in mesh_shape:
+        if "seq" in mesh_shape:
             raise ValueError(
-                "pipeline parallelism composes with data parallelism only — "
-                f"drop 'model'/'seq' from mesh {dict(mesh_shape)}")
+                "pipeline parallelism does not compose with sequence "
+                "parallelism (the stage body's manual ring/ulysses attention "
+                f"would need the seq axis manual too) — drop 'seq' from mesh "
+                f"{dict(mesh_shape)}; 'model' (tp) and 'data' (dp) compose")
         kwargs["scan_blocks"] = True
     if config.num_experts > 1 and "pipe" in mesh_shape:
         raise ValueError(
