@@ -69,6 +69,10 @@ class ExperimentConfig:
     honor_diff_step: bool = False
     mesh: Optional[dict[str, int]] = None
     use_flash: "bool | str" = False  # False | True (Pallas) | "xla" (blockwise)
+    # Pallas kernel (block_q, block_kv) override; None = kernel defaults.
+    # The bench's --flash-block-sweep measures candidates — pin its winner
+    # here (e.g. ``flash_blocks: [512, 1024]`` in the 200px yaml).
+    flash_blocks: Optional[tuple] = None
     use_sincos_pos: bool = False
     sp_mode: str = "ring"  # seq-parallel strategy: ring | ulysses
     remat: bool = False
@@ -161,6 +165,7 @@ class ExperimentConfig:
             num_heads=self.head,
             total_steps=self.total_steps,
             use_flash=self.use_flash,
+            flash_blocks=self.flash_blocks,
             use_sincos_pos=self.use_sincos_pos,
             remat=self.remat,
             scan_blocks=self.scan_blocks,
@@ -168,6 +173,27 @@ class ExperimentConfig:
             moe_capacity_factor=self.moe_capacity_factor,
             moe_dispatch=self.moe_dispatch,
         )
+
+
+def _check_flash_blocks(value, use_flash):
+    if value is None:
+        return None
+    if use_flash is False:
+        # the same silent-misconfiguration class the unknown-key check
+        # kills: a tuned pair pinned in the yaml with use_flash unset would
+        # validate, thread through model_kwargs, and then attend DENSE
+        raise ValueError(
+            "flash_blocks is set but use_flash is false — the blocks would "
+            "be silently ignored; set use_flash: true (or 'xla', which "
+            "uses only the block_kv half)")
+    try:
+        bq, bkv = (int(v) for v in value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"flash_blocks must be a [block_q, block_kv] pair, got {value!r}")
+    if bq < 1 or bkv < 1:
+        raise ValueError(f"flash_blocks must be positive, got {value!r}")
+    return (bq, bkv)
 
 
 def _check_use_flash(value):
@@ -237,10 +263,40 @@ def _check_ema_decay(value: float) -> float:
     return value
 
 
+#: every key load_config reads, including the reference-schema aliases —
+#: anything else in the YAML is a typo and must fail loud: this loader is
+#: .get()-based, so an unknown key (`use_flahs: true`, `scan_block: true`)
+#: would otherwise be silently ignored and the run silently misconfigured
+_KNOWN_KEYS = frozenset({
+    "initializing", "resume", "AMP", "amp", "framework", "num_devices",
+    "num_gpus", "batch_size", "epoch", "base_lr", "dataStorage",
+    "image_size", "diff_step", "patch_size", "embed_dim", "depth", "head",
+    "dataset", "seed", "honor_diff_step", "mesh", "use_flash", "flash_blocks",
+    "use_sincos_pos", "sp_mode", "remat", "profile_steps", "nan_checks",
+    "cache_images", "device_degrade", "async_checkpoint", "scan_blocks",
+    "microbatches", "snapshot_epochs", "ema_decay", "num_experts",
+    "moe_capacity_factor", "moe_aux_weight", "moe_dispatch", "grad_accum",
+    "steps_per_dispatch",
+})
+
+
 def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentConfig:
     """Parse a reference-schema YAML into an ExperimentConfig."""
     with open(yaml_path) as f:
         raw = yaml.safe_load(f)
+    unknown = sorted(set(raw) - _KNOWN_KEYS)
+    if unknown:
+        import difflib
+
+        hints = []
+        for k in unknown:
+            close = difflib.get_close_matches(k, _KNOWN_KEYS, n=1)
+            hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)"
+                                     if close else ""))
+        raise ValueError(
+            f"{yaml_path}: unknown config key(s) {', '.join(hints)} — "
+            "a misspelled key would be silently ignored and the run "
+            "silently misconfigured; remove or fix it")
     name = exp_name or os.path.splitext(os.path.basename(yaml_path))[0]
     epoch = raw.get("epoch", [0, 100])
     return ExperimentConfig(
@@ -265,6 +321,9 @@ def load_config(yaml_path: str, exp_name: Optional[str] = None) -> ExperimentCon
         honor_diff_step=bool(raw.get("honor_diff_step", False)),
         mesh=raw.get("mesh"),
         use_flash=_check_use_flash(raw.get("use_flash", False)),
+        flash_blocks=_check_flash_blocks(
+            raw.get("flash_blocks"),
+            _check_use_flash(raw.get("use_flash", False))),
         use_sincos_pos=bool(raw.get("use_sincos_pos", False)),
         sp_mode=_check_sp_mode(raw.get("sp_mode", "ring")),
         remat=bool(raw.get("remat", False)),

@@ -49,22 +49,25 @@ def main():
     if jax.default_backend() == "cpu":
         print("WARNING: running on CPU — numbers are not TPU numbers")
 
-    # -- 1. flash vs dense numerics on-chip (64px + 200px shapes) ----------
+    # -- 1. fused-attention numerics on-chip (64px + 200px shapes): the
+    # Pallas kernel AND the pure-XLA blockwise path, each vs dense ---------
     for name in ("vit_tiny",) + (() if args.quick else ("oxford_flower_200_p4",)):
         cfg = MODEL_CONFIGS[name]
         dense_m = DiffusionViT(dtype=jnp.bfloat16, **cfg)
-        flash_m = DiffusionViT(dtype=jnp.bfloat16, use_flash=True, **cfg)
         H, W = cfg["img_size"]
         x = jax.random.normal(jax.random.PRNGKey(0), (2, H, W, 3), jnp.float32)
         t = jnp.array([3, 1500], jnp.int32)
         params = dense_m.init(jax.random.PRNGKey(1), x, t)["params"]
         a = np.asarray(dense_m.apply({"params": params}, x, t))
-        b = np.asarray(flash_m.apply({"params": params}, x, t))
-        err = np.abs(a - b).max()
-        ok = err < 0.05  # bf16 blockwise-vs-dense softmax tolerance
-        print(f"[flash-parity] {name}: max|dense-flash|={err:.4f} {'OK' if ok else 'FAIL'}")
-        if not ok:
-            return 1
+        for impl, label in ((True, "flash"), ("xla", "xla")):
+            m = DiffusionViT(dtype=jnp.bfloat16, use_flash=impl, **cfg)
+            b = np.asarray(m.apply({"params": params}, x, t))
+            err = np.abs(a - b).max()
+            ok = err < 0.05  # bf16 blockwise-vs-dense softmax tolerance
+            print(f"[{label}-parity] {name}: max|dense-{label}|={err:.4f} "
+                  f"{'OK' if ok else 'FAIL'}")
+            if not ok:
+                return 1
 
     # -- 2. train step + sampler numerics (finite, in-range) ---------------
     model = DiffusionViT(dtype=jnp.bfloat16, **MODEL_CONFIGS["vit_tiny"])

@@ -50,6 +50,40 @@ def test_config_derivation_rules(tmp_path, synthetic_image_dir):
     assert cfg2.total_steps == 6
 
 
+def test_config_rejects_unknown_keys(tmp_path, synthetic_image_dir):
+    """A typo'd key must fail loud with a did-you-mean hint — the .get()-
+    based loader would otherwise silently ignore it and the run would be
+    silently misconfigured (e.g. `use_flahs: true` training dense)."""
+    path = _write_config(str(tmp_path), synthetic_image_dir, use_flahs=True)
+    with pytest.raises(ValueError, match="use_flahs.*did you mean 'use_flash'"):
+        load_config(path, "exp")
+    path = _write_config(str(tmp_path), synthetic_image_dir,
+                         totally_novel_knob=1)
+    with pytest.raises(ValueError, match="totally_novel_knob"):
+        load_config(path, "exp")
+
+
+def test_config_flash_blocks_plumbed(tmp_path, synthetic_image_dir):
+    """`flash_blocks: [bq, bkv]` reaches the model (the --flash-block-sweep
+    winner is pinnable in the YAML); malformed values fail loud."""
+    from ddim_cold_tpu.train.trainer import build_model
+
+    path = _write_config(str(tmp_path), synthetic_image_dir,
+                         use_flash=True, flash_blocks=[512, 1024])
+    cfg = load_config(path, "exp")
+    assert cfg.flash_blocks == (512, 1024)
+    assert build_model(cfg).flash_blocks == (512, 1024)
+    bad = _write_config(str(tmp_path), synthetic_image_dir,
+                        use_flash=True, flash_blocks=[512])
+    with pytest.raises(ValueError, match="flash_blocks"):
+        load_config(bad, "exp")
+    # blocks without use_flash would silently attend dense — fail loud
+    noflash = _write_config(str(tmp_path), synthetic_image_dir,
+                            flash_blocks=[512, 1024])
+    with pytest.raises(ValueError, match="use_flash is false"):
+        load_config(noflash, "exp")
+
+
 @pytest.fixture(scope="module")
 def trained_run(tmp_path_factory, synthetic_image_dir):
     """Train 2 epochs on the 10-image folder (shared by several tests)."""
