@@ -93,6 +93,20 @@ def main(argv=None):
         f"moe_idx_dp{n//2}_ep2": ({"data": n // 2, "expert": 2},
                                   {"num_experts": 4, "moe_dispatch": "index"}),
     }
+    if n % 4 == 0 and n >= 8:
+        # composed layouts (round 5): pipe×tp rides GSPMD-auto 'model'
+        # inside each stage; seq×tp with both sp strategies (ring keeps
+        # heads tp-sharded through the rotation, ulysses all-to-alls each
+        # tp group's local heads). Gated like __graft_entry__'s composed
+        # legs — an n//4 mesh cannot cover 2 or 6 devices.
+        layouts.update({
+            f"dp{n//4}_pipe2_tp2": ({"data": n // 4, "pipe": 2, "model": 2},
+                                    {}),
+            f"dp{n//4}_seq2_tp2_ring": ({"data": n // 4, "seq": 2,
+                                         "model": 2}, {}),
+            f"dp{n//4}_seq2_tp2_ul": ({"data": n // 4, "seq": 2, "model": 2},
+                                      {"sp_mode": "ulysses"}),
+        })
 
     rng = np.random.RandomState(0)
     batch = (
@@ -101,14 +115,24 @@ def main(argv=None):
         rng.randint(1, 7, size=(args.batch,)).astype(np.int32),
     )
 
+    # ONE precision for every row, per backend: bf16 on real TPU (the MXU
+    # path users run), f32 on the virtual-CPU mesh. CPU has no native bf16 —
+    # XLA emulates it, so amp=True there measures each layout's emulation
+    # surface as much as its schedule/collective overhead (measured: it
+    # inverts the dp-vs-model-parallel ordering), and the bf16 tp-psum
+    # inside the partially-manual pipelined shard_map CHECK-fails in XLA's
+    # CPU AllReducePromotion pass outright (pipeline.py docstring).
+    amp = bool(args.tpu)
     results = {}
     for name, (mesh_shape, extra) in layouts.items():
-        cfg = ExperimentConfig(
-            exp_name="pbench", amp=True, batch_size=args.batch,
+        kw = dict(
+            exp_name="pbench", amp=amp, batch_size=args.batch,
             image_size=(args.img, args.img), patch_size=args.patch,
             embed_dim=args.embed, depth=args.depth, head=args.heads,
-            mesh=mesh_shape, **extra,
+            mesh=mesh_shape,
         )
+        kw.update(extra)
+        cfg = ExperimentConfig(**kw)
         mesh = make_mesh(mesh_shape)
         model = build_model(cfg, mesh=mesh)
         state = create_train_state(model, jax.random.PRNGKey(0), 1e-3, 1000,
