@@ -140,6 +140,16 @@ class Attention(nn.Module):
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None
     sp_mode: str = "ring"
+    # manual-collective mode (pipe×sp composition): the module is ALREADY
+    # inside a shard_map whose manual axes include ``seq_axis`` (the
+    # pipeline executor, parallel/pipeline.py) — call the inner ring kernel
+    # directly on the local shard instead of wrapping a new shard_map.
+    # ``seq_valid_len`` is the unpadded global sequence length (ring padding
+    # is masked via kv_valid); ``seq_varying_axes`` names every manual axis
+    # the activations vary over, for the ring accumulators' vma typing.
+    seq_manual: bool = False
+    seq_valid_len: Optional[int] = None
+    seq_varying_axes: Optional[tuple] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -170,11 +180,39 @@ class Attention(nn.Module):
             # falling back to dense here would silently materialize the full
             # O(N²) global attention matrix — the exact thing sp exists to
             # avoid. Configs must zero attn_drop (trainer.build_model does).
+            # (need_weights=True — the probe path — deliberately still falls
+            # through to the dense global einsum.)
             raise ValueError(
                 "sequence-parallel attention cannot apply attention-dropout "
                 f"(attn_drop={self.attn_drop} active in training); set "
                 "attn_drop_rate=0.0 on the model")
-        if seq_parallel and weightless_ok:
+        if self.seq_manual and not weightless_ok:
+            # no dense fallback exists inside the manual region — a local
+            # einsum would silently attend block-diagonally
+            raise ValueError(
+                "manual sequence-parallel attention cannot apply "
+                "attention-dropout or return weights — set "
+                "attn_drop_rate=0.0 and need_weights=False")
+        if self.seq_manual:
+            # inside an enclosing manual shard_map (pipeline executor,
+            # pipe×sp): x is the LOCAL (B', N/sp, C) shard; run the inner
+            # ring kernel over the already-manual seq axis. A tp 'model'
+            # axis, if any, stays GSPMD-auto via the param specs. Padding
+            # keys (token dim padded up to the axis size) are masked via
+            # seq_valid_len.
+            from ddim_cold_tpu.parallel.ring_attention import ring_attention
+
+            valid = None
+            if self.seq_valid_len is not None:
+                pos = (jax.lax.axis_index(self.seq_axis) * N + jnp.arange(N))
+                valid = jnp.broadcast_to((pos < self.seq_valid_len)[None, :],
+                                         (B, N))
+            out = ring_attention(
+                q, k, v, valid, axis_name=self.seq_axis, scale=scale,
+                varying_axes=self.seq_varying_axes,
+            ).astype(self.dtype)
+            attn = None
+        elif seq_parallel and weightless_ok:
             if self.sp_mode == "ulysses":
                 from ddim_cold_tpu.parallel.ulysses import ulysses_self_attention
 
@@ -254,6 +292,10 @@ class Block(nn.Module):
     batch_axis: Optional[str] = None
     head_axis: Optional[str] = None
     sp_mode: str = "ring"
+    # manual-collective sp (pipe×sp; see Attention.seq_manual)
+    seq_manual: bool = False
+    seq_valid_len: Optional[int] = None
+    seq_varying_axes: Optional[tuple] = None
     num_experts: int = 1  # >1: Switch-MoE MLP (models/moe.py, 'expert' axis)
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # routing impl: "einsum" | "index" (moe.py)
@@ -278,6 +320,9 @@ class Block(nn.Module):
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
             sp_mode=self.sp_mode,
+            seq_manual=self.seq_manual,
+            seq_valid_len=self.seq_valid_len,
+            seq_varying_axes=self.seq_varying_axes,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -328,17 +373,23 @@ class Block(nn.Module):
         return x
 
 
-def block_template(model: "DiffusionViT") -> "Block":
+def block_template(model: "DiffusionViT", *, seq_manual_axis=None,
+                   seq_valid_len=None, seq_varying_axes=None) -> "Block":
     """Unbound single-layer Block matching ``model``'s scan_blocks layout —
     the pipeline executor (parallel/pipeline.py) applies it functionally per
     stage layer with slices of the stacked ``blocks`` params (drop-path rate
     arrives traced). Module-level fn: constructing a child inside an unbound
-    module method trips flax's parent tracking."""
+    module method trips flax's parent tracking.
+
+    ``seq_manual_axis`` builds the pipe×sp variant: attention runs the inner
+    ring kernel over that (already-manual) axis on the local shard."""
     return Block(
         dim=model.embed_dim, num_heads=model.num_heads, mlp_ratio=model.mlp_ratio,
         qkv_bias=model.qkv_bias, qk_scale=model.qk_scale, drop=model.drop_rate,
         attn_drop=model.attn_drop_rate, drop_path=0.0, dtype=model.dtype,
         use_flash=model.use_flash, flash_blocks=model.flash_blocks,
+        seq_manual=seq_manual_axis is not None, seq_axis=seq_manual_axis,
+        seq_valid_len=seq_valid_len, seq_varying_axes=seq_varying_axes,
     )
 
 

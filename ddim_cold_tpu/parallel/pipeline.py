@@ -22,8 +22,10 @@ since the ``shard_map`` is manual over only the pipe/data axes — with TENSOR
 parallelism: a ``model`` mesh axis stays in GSPMD auto mode, so
 ``pipeline_param_specs(tensor_axes=("model",))`` Megatron-splits each
 stage's kernels and the partitioner inserts the psums inside the stage body
-(pipe×tp, VERDICT r4 weak #6). Manual sequence parallelism (ring/ulysses)
-still cannot ride inside a stage; the trainer enforces that.
+(pipe×tp, VERDICT r4 weak #6). RING sequence parallelism composes too: with
+a ``seq`` axis in the mesh the tokens shard over it as a second manual axis
+and the stage body runs the inner ring kernel directly (pipe×sp; the
+manual-ulysses variant is not implemented).
 
 Known backend quirk: a BF16 tp-psum inside this partially-manual shard_map
 CHECK-fails in XLA's *CPU* AllReducePromotion pass (process abort) — f32
@@ -51,6 +53,7 @@ def pipeline_blocks(
     *,
     axis: str = "pipe",
     batch_axis: Optional[str] = "data",
+    seq_axis: Optional[str] = None,
     n_microbatch: int = 2,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
@@ -63,18 +66,37 @@ def pipeline_blocks(
     leading dim = depth; ``dpr`` — (depth,) stochastic-depth rates;
     ``tokens`` — (B, N, C) trunk input. Requires depth % n_stages == 0 and
     B % n_microbatch == 0 (per data shard).
+
+    ``seq_axis`` (pipe×sp): the token dim is additionally sharded over that
+    manual axis and ``block`` must be the manual-ring template
+    (``block_template(model, seq_manual_axis=seq_axis, …)``). Tokens are
+    padded to a multiple of the axis size here and unpadded on return; the
+    pad positions are masked inside the ring via the template's
+    ``seq_valid_len``.
     """
     n_stages = int(mesh.shape[axis])
     depth = int(jax.tree.leaves(stacked_params)[0].shape[0])
     if depth % n_stages != 0:
         raise ValueError(f"depth {depth} not divisible by {n_stages} pipeline stages")
     bps = depth // n_stages
-    B = tokens.shape[0]
+    B, N = tokens.shape[0], tokens.shape[1]
     M = int(n_microbatch)
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     if batch_axis is not None and batch_axis not in mesh.shape:
         batch_axis = None
+    if seq_axis is not None:
+        if not getattr(block, "seq_manual", False):
+            # sharding tokens under a NON-manual block would run each local
+            # einsum on its own shard — block-diagonal attention, silently
+            # wrong output with no error
+            raise ValueError(
+                "seq_axis is set but `block` is not the manual-ring "
+                "template — build it with block_template(model, "
+                "seq_manual_axis=...)")
+        n_pad = (-N) % int(mesh.shape[seq_axis])
+        if n_pad:
+            tokens = jnp.pad(tokens, [(0, 0), (0, n_pad), (0, 0)])
 
     # (depth, ...) → (S, bps, ...): stage-major so P(axis) shards stages
     stage_params = jax.tree.map(
@@ -96,6 +118,12 @@ def pipeline_blocks(
         dpr_s = dpr_s[0]
         s = jax.lax.axis_index(axis)
 
+        # rng coordinate: fold the DATA shard in (different samples need
+        # different masks) but NOT the seq shard — seq shards hold pieces of
+        # the SAME samples, and the per-sample stochastic-depth Bernoulli
+        # must agree across them or a sample's residual gets half-dropped.
+        # (Token-dropout masks therefore repeat across seq shards at equal
+        # local offsets — correlated regularization, still unbiased.)
         d = (jax.lax.axis_index(batch_axis) if (use_rng and batch_axis is not None)
              else 0)
         n_data = int(mesh.shape.get(batch_axis, 1)) if batch_axis is not None else 1
@@ -152,15 +180,15 @@ def pipeline_blocks(
         out = jnp.where(s == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
         return jax.lax.psum(out, axis)
 
-    tok_spec = P(None, batch_axis, None, None)
+    tok_spec = P(None, batch_axis, seq_axis, None)
     rng_arg = (dropout_rng if use_rng else jax.random.PRNGKey(0))[None]
-    # manual ONLY over the pipeline (and dp) axes: any other mesh axis —
+    # manual ONLY over the pipeline (and dp/sp) axes: any other mesh axis —
     # 'model' in particular — stays in GSPMD auto mode, so tensor-parallel
     # param shardings (pipeline_param_specs tensor_axes) partition the
     # stage body's einsums without the block code knowing (pipe×tp
     # composition, VERDICT r4 weak #6; specs may not name auto axes — the
     # tp sharding rides on the param arrays themselves)
-    manual = {axis} | ({batch_axis} if batch_axis is not None else set())
+    manual = {axis} | {a for a in (batch_axis, seq_axis) if a is not None}
     fn = shard_map(
         per_device,
         mesh=mesh,
@@ -169,15 +197,24 @@ def pipeline_blocks(
         axis_names=frozenset(manual),
     )
     out = fn(stage_params, dpr_st, mb, rng_arg)
-    return out.reshape(tokens.shape)
+    out = out.reshape(tokens.shape)
+    return out[:, :N]  # drop ring padding (no-op when seq_axis is None)
 
 
 def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
                          batch_axis: Optional[str] = "data",
+                         seq_axis: Optional[str] = "seq",
                          n_microbatch: int = 2):
     """An ``apply_fn`` drop-in for ``model.apply`` that routes the block trunk
     through the pipeline: embed (replicated, cheap) → pipelined blocks →
-    head. ``model`` must be built with ``scan_blocks=True``."""
+    head. ``model`` must be built with ``scan_blocks=True``.
+
+    Composition is MESH-driven, the model stays plain: a ``model`` axis adds
+    GSPMD tensor parallelism via ``pipeline_param_specs(tensor_axes=…)``; a
+    ``seq_axis`` present in the mesh adds RING sequence parallelism inside
+    each stage (the block template runs the inner ring kernel over the
+    already-manual axis — pipe×sp; requires ``attn_drop_rate == 0``, same
+    rule as every sequence-parallel path)."""
     if not model.scan_blocks:
         raise ValueError("pipelined apply requires scan_blocks=True")
     if getattr(model, "num_experts", 1) > 1:
@@ -191,18 +228,34 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
             "(the pipeline stage body drops sown collections) — use an "
             "'expert' mesh axis instead")
     if model.seq_axis is not None or model.head_axis is not None:
-        # the stage body applies a plain dense block template — the MANUAL
-        # sequence-parallel attention (ring/ulysses) configured on the model
-        # would silently vanish. Tensor parallelism needs NO model field:
-        # it composes via pipeline_param_specs(tensor_axes=…) + GSPMD auto
-        # axes, the model code unchanged.
+        # composition is mesh-driven HERE, not via model fields: a model
+        # built with the global-collective sp/tp attention would nest a
+        # shard_map inside the pipeline's manual region.
         raise ValueError(
-            "pipeline parallelism does not compose with manual sequence "
-            "parallelism (model has seq_axis/head_axis set); tp composes "
-            "via a 'model' mesh axis, sp does not")
+            "pipelined apply composes via MESH axes, not model fields — "
+            "build the model plain (no seq_axis/head_axis) and put "
+            "'seq'/'model' in the mesh")
     from ddim_cold_tpu.models.vit import block_template
 
-    block = block_template(model)
+    sp = (int(mesh.shape.get(seq_axis, 1))
+          if seq_axis is not None and seq_axis in mesh.shape else 1)
+    if sp > 1:
+        if getattr(model, "sp_mode", "ring") == "ulysses":
+            raise ValueError(
+                "pipe×sp supports sp_mode='ring' only (the manual-ulysses "
+                "all-to-all variant is not implemented)")
+        # attn_drop_rate > 0 is fine in EVAL (dropout inactive); a TRAINING
+        # apply raises at trace time inside the manual attention branch —
+        # same rule as every sequence-parallel path (trainer zeroes it)
+        n_tokens = model.num_patches + 1  # + cls/time token (vit.py)
+        manual = tuple(a for a in (seq_axis, batch_axis, axis)
+                       if a is not None and a in mesh.shape)
+        block = block_template(model, seq_manual_axis=seq_axis,
+                               seq_valid_len=n_tokens,
+                               seq_varying_axes=manual)
+    else:
+        seq_axis = None
+        block = block_template(model)
     dpr = np.linspace(0.0, model.drop_path_rate, model.depth)
 
     def apply_fn(variables, x, t, deterministic: bool = True, rngs=None):
@@ -212,7 +265,8 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
                              deterministic=deterministic, rngs=rngs)
         tokens = pipeline_blocks(
             block, params["blocks"], dpr, tokens, mesh,
-            axis=axis, batch_axis=batch_axis, n_microbatch=n_microbatch,
+            axis=axis, batch_axis=batch_axis, seq_axis=seq_axis,
+            n_microbatch=n_microbatch,
             deterministic=deterministic, dropout_rng=dropout_rng,
             remat=model.remat,
         )

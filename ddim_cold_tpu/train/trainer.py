@@ -202,13 +202,17 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     kwargs = dict(config.model_kwargs())
     mesh_shape = getattr(mesh, "shape", {}) if mesh is not None else {}
     if "pipe" in mesh_shape:
-        if "seq" in mesh_shape:
+        if "seq" in mesh_shape and config.sp_mode == "ulysses":
             raise ValueError(
-                "pipeline parallelism does not compose with sequence "
-                "parallelism (the stage body's manual ring/ulysses attention "
-                f"would need the seq axis manual too) — drop 'seq' from mesh "
-                f"{dict(mesh_shape)}; 'model' (tp) and 'data' (dp) compose")
+                "pipe×sp supports sp_mode='ring' only (the pipeline runs "
+                "the inner ring kernel over the manual seq axis; a "
+                "manual-ulysses variant is not implemented)")
+        # composition is mesh-driven inside the pipeline executor
+        # (make_pipelined_apply): the model stays plain — seq/model fields
+        # would nest a shard_map inside the pipeline's manual region
         kwargs["scan_blocks"] = True
+        if "seq" in mesh_shape:
+            kwargs["attn_drop_rate"] = 0.0  # manual ring: same sp rule
     if config.num_experts > 1 and "pipe" in mesh_shape:
         raise ValueError(
             "num_experts > 1 does not compose with pipeline parallelism "
@@ -216,7 +220,7 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
             "and drops sown collections, losing the MoE aux loss; plain "
             "scan_blocks composes fine) — use an 'expert' (and 'data') "
             "mesh axis instead")
-    if "seq" in mesh_shape:
+    if "seq" in mesh_shape and "pipe" not in mesh_shape:
         # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
         # tp axis the ring keeps heads sharded over it (no qkv all-gather)
         batch_axis = "data" if "data" in mesh_shape else None
