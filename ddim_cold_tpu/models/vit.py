@@ -142,11 +142,14 @@ class Attention(nn.Module):
     sp_mode: str = "ring"
     # manual-collective mode (pipe×sp composition): the module is ALREADY
     # inside a shard_map whose manual axes include ``seq_axis`` (the
-    # pipeline executor, parallel/pipeline.py) — call the inner ring kernel
-    # directly on the local shard instead of wrapping a new shard_map.
-    # ``seq_valid_len`` is the unpadded global sequence length (ring padding
-    # is masked via kv_valid); ``seq_varying_axes`` names every manual axis
-    # the activations vary over, for the ring accumulators' vma typing.
+    # pipeline executor, parallel/pipeline.py) — call the inner sp kernel
+    # (``sp_mode``: ring rotation or the ulysses all-to-all pair) directly
+    # on the local shard instead of wrapping a new shard_map.
+    # ``seq_valid_len`` is the unpadded global sequence length (ring masks
+    # the padding via kv_valid; ulysses slices it off between its two
+    # all-to-alls); ``seq_varying_axes`` names every manual axis the
+    # activations vary over, for the ring accumulators' vma typing
+    # (ulysses needs none — its body is stateless).
     seq_manual: bool = False
     seq_valid_len: Optional[int] = None
     seq_varying_axes: Optional[tuple] = None
@@ -196,21 +199,31 @@ class Attention(nn.Module):
         if self.seq_manual:
             # inside an enclosing manual shard_map (pipeline executor,
             # pipe×sp): x is the LOCAL (B', N/sp, C) shard; run the inner
-            # ring kernel over the already-manual seq axis. A tp 'model'
+            # sp kernel over the already-manual seq axis. A tp 'model'
             # axis, if any, stays GSPMD-auto via the param specs. Padding
-            # keys (token dim padded up to the axis size) are masked via
-            # seq_valid_len.
-            from ddim_cold_tpu.parallel.ring_attention import ring_attention
+            # tokens (dim padded up to the axis size) are masked (ring) or
+            # sliced between the all-to-alls (ulysses) via seq_valid_len.
+            if self.sp_mode == "ulysses":
+                from ddim_cold_tpu.parallel.ulysses import ulysses_attention
 
-            valid = None
-            if self.seq_valid_len is not None:
-                pos = (jax.lax.axis_index(self.seq_axis) * N + jnp.arange(N))
-                valid = jnp.broadcast_to((pos < self.seq_valid_len)[None, :],
-                                         (B, N))
-            out = ring_attention(
-                q, k, v, valid, axis_name=self.seq_axis, scale=scale,
-                varying_axes=self.seq_varying_axes,
-            ).astype(self.dtype)
+                out = ulysses_attention(
+                    q, k, v, axis_name=self.seq_axis,
+                    n_valid=self.seq_valid_len, scale=scale,
+                    use_flash=self.use_flash, flash_blocks=self.flash_blocks,
+                ).astype(self.dtype)
+            else:
+                from ddim_cold_tpu.parallel.ring_attention import ring_attention
+
+                valid = None
+                if self.seq_valid_len is not None:
+                    pos = (jax.lax.axis_index(self.seq_axis) * N
+                           + jnp.arange(N))
+                    valid = jnp.broadcast_to(
+                        (pos < self.seq_valid_len)[None, :], (B, N))
+                out = ring_attention(
+                    q, k, v, valid, axis_name=self.seq_axis, scale=scale,
+                    varying_axes=self.seq_varying_axes,
+                ).astype(self.dtype)
             attn = None
         elif seq_parallel and weightless_ok:
             if self.sp_mode == "ulysses":
@@ -388,6 +401,7 @@ def block_template(model: "DiffusionViT", *, seq_manual_axis=None,
         qkv_bias=model.qkv_bias, qk_scale=model.qk_scale, drop=model.drop_rate,
         attn_drop=model.attn_drop_rate, drop_path=0.0, dtype=model.dtype,
         use_flash=model.use_flash, flash_blocks=model.flash_blocks,
+        sp_mode=model.sp_mode,
         seq_manual=seq_manual_axis is not None, seq_axis=seq_manual_axis,
         seq_valid_len=seq_valid_len, seq_varying_axes=seq_varying_axes,
     )

@@ -22,10 +22,10 @@ since the ``shard_map`` is manual over only the pipe/data axes — with TENSOR
 parallelism: a ``model`` mesh axis stays in GSPMD auto mode, so
 ``pipeline_param_specs(tensor_axes=("model",))`` Megatron-splits each
 stage's kernels and the partitioner inserts the psums inside the stage body
-(pipe×tp, VERDICT r4 weak #6). RING sequence parallelism composes too: with
-a ``seq`` axis in the mesh the tokens shard over it as a second manual axis
-and the stage body runs the inner ring kernel directly (pipe×sp; the
-manual-ulysses variant is not implemented).
+(pipe×tp, VERDICT r4 weak #6). Sequence parallelism composes too: with a
+``seq`` axis in the mesh the tokens shard over it as a second manual axis
+and the stage body runs the inner sp kernel directly — ring rotation or the
+ulysses all-to-all pair, per the model's ``sp_mode`` (pipe×sp).
 
 Known backend quirk: a BF16 tp-psum inside this partially-manual shard_map
 CHECK-fails in XLA's *CPU* AllReducePromotion pass (process abort) — f32
@@ -58,6 +58,7 @@ def pipeline_blocks(
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
     remat: bool = False,
+    check_vma: bool = True,
 ) -> jax.Array:
     """Run the transformer trunk through the pipeline.
 
@@ -195,6 +196,7 @@ def pipeline_blocks(
         in_specs=(P(axis), P(axis), tok_spec, P()),
         out_specs=tok_spec,
         axis_names=frozenset(manual),
+        check_vma=check_vma,
     )
     out = fn(stage_params, dpr_st, mb, rng_arg)
     out = out.reshape(tokens.shape)
@@ -239,20 +241,25 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
 
     sp = (int(mesh.shape.get(seq_axis, 1))
           if seq_axis is not None and seq_axis in mesh.shape else 1)
+    check_vma = True
     if sp > 1:
-        if getattr(model, "sp_mode", "ring") == "ulysses":
-            raise ValueError(
-                "pipe×sp supports sp_mode='ring' only (the manual-ulysses "
-                "all-to-all variant is not implemented)")
         # attn_drop_rate > 0 is fine in EVAL (dropout inactive); a TRAINING
         # apply raises at trace time inside the manual attention branch —
-        # same rule as every sequence-parallel path (trainer zeroes it)
+        # same rule as every sequence-parallel path (trainer zeroes it).
+        # sp_mode picks the manual kernel: ring (ppermute rotation) or
+        # ulysses (all-to-all head split on the stage's local heads).
         n_tokens = model.num_patches + 1  # + cls/time token (vit.py)
         manual = tuple(a for a in (seq_axis, batch_axis, axis)
                        if a is not None and a in mesh.shape)
         block = block_template(model, seq_manual_axis=seq_axis,
                                seq_valid_len=n_tokens,
                                seq_varying_axes=manual)
+        if model.sp_mode == "ulysses" and model.use_flash:
+            # same exemption the global ulysses wrapper applies, for BOTH
+            # fused paths: the Pallas kernel's internal jaxpr trips the vma
+            # matcher in interpret mode, and the xla blockwise scan's
+            # unvarying o/l/m carry inits mix with the varying q/k/v
+            check_vma = False
     else:
         seq_axis = None
         block = block_template(model)
@@ -268,7 +275,7 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
             axis=axis, batch_axis=batch_axis, seq_axis=seq_axis,
             n_microbatch=n_microbatch,
             deterministic=deterministic, dropout_rng=dropout_rng,
-            remat=model.remat,
+            remat=model.remat, check_vma=check_vma,
         )
         return model.apply({"params": params}, x, t, stage="head",
                            tokens=tokens, deterministic=deterministic, rngs=rngs)

@@ -31,6 +31,71 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    n_valid: Optional[int] = None,
+    scale: float,
+    use_flash: "bool | str" = False,
+    flash_blocks: Optional[tuple] = None,
+) -> jax.Array:
+    """Manual (inside-shard_map) Ulysses attention on LOCAL shards — the
+    body both :func:`ulysses_self_attention` (its own shard_map) and the
+    pipeline executor's pipe×sp stage attention (an enclosing manual region,
+    parallel/pipeline.py) run.
+
+    q/k/v: per-device ``(B', n_loc, H_loc, D)`` with the sequence dim
+    sharded over ``axis_name`` (padded so ``n_loc * S`` covers the
+    sequence); ``n_valid`` is the unpadded global length — pad positions
+    are sliced off between the two all-to-alls so the local attention never
+    sees them. Requires ``H_loc % S == 0``.
+    """
+    S = jax.lax.psum(1, axis_name)  # static inside shard_map
+    B, n_loc, H_loc, D = q.shape
+    if H_loc % S != 0:
+        raise ValueError(
+            f"ulysses needs local heads ({H_loc}) divisible by the "
+            f"'{axis_name}' axis ({S}); use sp_mode='ring' otherwise")
+    Np = n_loc * S
+    n_valid = Np if n_valid is None else n_valid
+    n_pad = Np - n_valid
+
+    # seq-sharded → head-sharded: every device gets the whole sequence for
+    # its H_loc/S heads
+    gather = partial(jax.lax.all_to_all, axis_name=axis_name,
+                     split_axis=2, concat_axis=1, tiled=True)
+    qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H_loc/S, D)
+    qf, kf, vf = (x[:, :n_valid] for x in (qf, kf, vf))
+
+    if use_flash == "xla":
+        from ddim_cold_tpu.ops.flash_attention import blockwise_attention_xla
+
+        out = blockwise_attention_xla(
+            qf, kf, vf, scale,
+            *((flash_blocks[1],) if flash_blocks else ())).astype(q.dtype)
+    elif use_flash:
+        from ddim_cold_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(
+            qf, kf, vf, scale, *(flash_blocks or ())).astype(q.dtype)
+    else:
+        logits = jnp.einsum(
+            "bnhd,bmhd->bhnm", qf.astype(jnp.float32),
+            kf.astype(jnp.float32)) * scale
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum(
+            "bhnm,bmhd->bnhd", p, vf.astype(jnp.float32)).astype(q.dtype)
+
+    if n_pad:
+        out = jnp.pad(out, [(0, 0), (0, n_pad), (0, 0), (0, 0)])
+    # head-sharded → seq-sharded
+    return jax.lax.all_to_all(out, axis_name=axis_name,
+                              split_axis=1, concat_axis=2, tiled=True)
+
+
 def ulysses_self_attention(
     q: jax.Array,
     k: jax.Array,
@@ -83,37 +148,10 @@ def ulysses_self_attention(
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
     Np = N + n_pad
 
-    def per_device(q, k, v):  # (B', Np/S, H, D)
-        # seq-sharded → head-sharded: every device gets the whole sequence
-        # for its H/S heads
-        gather = partial(jax.lax.all_to_all, axis_name=axis,
-                         split_axis=2, concat_axis=1, tiled=True)
-        qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H/S, D)
-        qf, kf, vf = (x[:, :N] for x in (qf, kf, vf))  # drop ring padding
-
-        if use_flash == "xla":
-            from ddim_cold_tpu.ops.flash_attention import blockwise_attention_xla
-
-            out = blockwise_attention_xla(
-                qf, kf, vf, scale,
-                *((flash_blocks[1],) if flash_blocks else ())).astype(q.dtype)
-        elif use_flash:
-            from ddim_cold_tpu.ops.flash_attention import flash_attention
-
-            out = flash_attention(
-                qf, kf, vf, scale, *(flash_blocks or ())).astype(q.dtype)
-        else:
-            logits = jnp.einsum(
-                "bnhd,bmhd->bhnm", qf.astype(jnp.float32),
-                kf.astype(jnp.float32)) * scale
-            p = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum(
-                "bhnm,bmhd->bnhd", p, vf.astype(jnp.float32)).astype(q.dtype)
-
-        out = jnp.pad(out, [(0, 0), (0, n_pad), (0, 0), (0, 0)])
-        # head-sharded → seq-sharded
-        return jax.lax.all_to_all(out, axis_name=axis,
-                                  split_axis=1, concat_axis=2, tiled=True)
+    def per_device(q, k, v):  # (B', Np/S, H_loc, D)
+        return ulysses_attention(q, k, v, axis_name=axis, n_valid=N,
+                                 scale=scale, use_flash=use_flash,
+                                 flash_blocks=flash_blocks)
 
     seq_spec = P(batch_axis, axis, head_axis, None)
     # check_vma off: the body is stateless (two all-to-alls around a local

@@ -202,17 +202,15 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
     kwargs = dict(config.model_kwargs())
     mesh_shape = getattr(mesh, "shape", {}) if mesh is not None else {}
     if "pipe" in mesh_shape:
-        if "seq" in mesh_shape and config.sp_mode == "ulysses":
-            raise ValueError(
-                "pipe×sp supports sp_mode='ring' only (the pipeline runs "
-                "the inner ring kernel over the manual seq axis; a "
-                "manual-ulysses variant is not implemented)")
         # composition is mesh-driven inside the pipeline executor
         # (make_pipelined_apply): the model stays plain — seq/model fields
-        # would nest a shard_map inside the pipeline's manual region
+        # would nest a shard_map inside the pipeline's manual region.
+        # sp_mode is the one field that travels: it picks the manual kernel
+        # (ring rotation or ulysses all-to-all) the stage attention runs.
         kwargs["scan_blocks"] = True
         if "seq" in mesh_shape:
-            kwargs["attn_drop_rate"] = 0.0  # manual ring: same sp rule
+            kwargs["attn_drop_rate"] = 0.0  # manual sp: same dropout rule
+            kwargs["sp_mode"] = config.sp_mode
     if config.num_experts > 1 and "pipe" in mesh_shape:
         raise ValueError(
             "num_experts > 1 does not compose with pipeline parallelism "

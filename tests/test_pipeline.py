@@ -204,8 +204,9 @@ def test_pipelined_composes_with_sp_and_tp(scanned_model_and_params):
 
 
 def test_pipeline_trainer_composes_with_sp(synthetic_image_dir, tmp_path):
-    """YAML mesh {seq, pipe} trains end to end with sp_mode ring (previously
-    rejected); ulysses still gets a clear refusal."""
+    """YAML mesh {seq, pipe} trains end to end under BOTH sp strategies
+    (previously rejected outright): ring rotation and the ulysses
+    all-to-all, each as the stage's manual attention kernel."""
     from ddim_cold_tpu.config import ExperimentConfig
     from ddim_cold_tpu.train.trainer import run
 
@@ -224,8 +225,25 @@ def test_pipeline_trainer_composes_with_sp(synthetic_image_dir, tmp_path):
         image_size=(16, 16), patch_size=8, embed_dim=32, depth=2, head=2,
         mesh={"seq": 2, "pipe": 2}, microbatches=2, sp_mode="ulysses",
     )
-    with pytest.raises(ValueError, match="ring"):
-        run(ul, str(tmp_path / "ul"), max_steps=2)
+    result = run(ul, str(tmp_path / "ul"), max_steps=2)
+    assert np.isfinite(result.best_loss)
+
+
+@pytest.mark.parametrize("impl", [False, "xla"])
+def test_pipelined_composes_with_ulysses_sp(scanned_model_and_params, impl):
+    """pipe×sp with the ulysses strategy: the stage attention all-to-alls
+    its local heads over the manual 'seq' axis (17 tokens over sp=2
+    exercises the pad-slice between the two all-to-alls). impl='xla' runs
+    the blockwise local attention there — the config that needs the
+    check_vma exemption (its scan carry inits are unvarying)."""
+    model, params, x, t = scanned_model_and_params
+    ul_model = DiffusionViT(scan_blocks=True, sp_mode="ulysses",
+                            use_flash=impl, **CFG)
+    mesh = make_mesh({"data": 2, "pipe": 2, "seq": 2})
+    pf = make_pipelined_apply(ul_model, mesh, n_microbatch=2)
+    want = np.asarray(jax.jit(model.apply)({"params": params}, x, t))
+    got = np.asarray(jax.jit(pf)({"params": params}, x, t))
+    np.testing.assert_allclose(got, want, atol=1e-5)
 
 
 def test_pipelined_dropout_independent_across_data_shards(scanned_model_and_params):
