@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
 
+#: kernel revision stamped into bench records (scripts/r05_stage_done.py keys
+#: re-measurement off it): "bf16-gemm-v2" = GEMMs in input dtype with f32 MXU
+#: accumulation (the r05 change); the original always-f32-GEMM kernel — the
+#: one every pre-r05b hardware record measured — had no stamp.
+KERNEL_REV = "bf16-gemm-v2"
+
 
 # ---------------------------------------------------------------------------
 # forward
@@ -58,11 +64,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)  # (bq, D)
-    k = k_ref[0].astype(jnp.float32)  # (bkv, D)
+    # GEMMs run in the INPUT dtype with f32 MXU accumulation
+    # (preferred_element_type): for bf16 models this is the native-speed MXU
+    # path (an explicit f32 upcast here costs ~4× MXU throughput on v5e and
+    # doubles VMEM traffic); for f32 inputs it is bit-identical to the old
+    # explicit-upcast form. Softmax stays f32 either way.
+    q = q_ref[0]  # (bq, D)
+    k = k_ref[0]  # (bkv, D)
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (bq, bkv)
+    ) * scale  # (bq, bkv) f32
     col = kv_i * block_kv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
     logits = jnp.where(col < n_valid, logits, _NEG_INF)
 
@@ -74,7 +85,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(logits - m_new)  # (bq, bkv)
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.dot(p, v_ref[0].astype(jnp.float32),
+    # p rounds to v's dtype for the MXU (f32 accumulate); exact for f32 v,
+    # ≤1 bf16 ulp per product for bf16 v — inside the model's own precision
+    pv = jnp.dot(p.astype(v_ref.dtype), v_ref[0],
                  preferred_element_type=jnp.float32)
     acc_ref[...] = acc_ref[...] * alpha + pv
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -209,16 +222,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)    # (bq, D)
-    k = k_ref[0].astype(jnp.float32)    # (bkv, D)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)  # (bq, D)
+    # input-dtype GEMMs, f32 accumulation — see _fwd_kernel
+    q = q_ref[0]    # (bq, D)
+    k = k_ref[0]    # (bkv, D)
+    v = v_ref[0]
+    do = do_ref[0]  # (bq, D)
     lse = lse_ref[0][:, :1]             # (bq, 1), lane-replicated block
     delta = delta_ref[0][:, :1]         # (bq, 1)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (bq, bkv)
+    ) * scale  # (bq, bkv) f32
     # zero both padded kv columns (zero-filled k would contribute exp(−lse))
     # and padded q rows (their lse ≈ −inf would blow up exp)
     col = kv_i * block_kv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -226,9 +240,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         jnp.int32, logits.shape, 0)
     p = jnp.where((col < n_valid) & (row < n_valid),
                   jnp.exp(logits - lse), 0.0)
-    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv) f32
     ds = p * (dp - delta)
-    acc_ref[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+    acc_ref[...] += jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32) * scale
 
     @pl.when(kv_i == n_kv - 1)
     def _emit():
@@ -248,16 +263,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32)    # (bq, D)
-    k = k_ref[0].astype(jnp.float32)    # (bkv, D)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)  # (bq, D)
+    # input-dtype GEMMs, f32 accumulation — see _fwd_kernel
+    q = q_ref[0]    # (bq, D)
+    k = k_ref[0]    # (bkv, D)
+    v = v_ref[0]
+    do = do_ref[0]  # (bq, D)
     lse = lse_ref[0][:, :1]             # (bq, 1), lane-replicated block
     delta = delta_ref[0][:, :1]         # (bq, 1)
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (bq, bkv)
+    ) * scale  # (bq, bkv) f32
     # a padded q row's garbage lse would poison VALID kv columns through the
     # column-sum — masking rows here is correctness, not hygiene
     row = q_i * block_q + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
@@ -266,11 +282,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p = jnp.where((row < n_valid) & (col < n_valid),
                   jnp.exp(logits - lse), 0.0)
     dv_acc[...] += jax.lax.dot_general(  # pᵀ·do: (bkv, D)
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv)
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (bq, bkv) f32
     ds = p * (dp - delta)
     dk_acc[...] += jax.lax.dot_general(  # dsᵀ·q: (bkv, D)
-        ds, q, (((0,), (0,)), ((), ())),
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
 
     @pl.when(q_i == n_q - 1)

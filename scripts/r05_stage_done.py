@@ -69,6 +69,20 @@ def stage_done(stage: str) -> bool:
     if stage == "apps200":
         return (os.path.exists(res(RUN200, "draft2img.png"))
                 and os.path.exists(res(RUN200, "interpolation.png")))
+    if stage == "bench_v2":
+        # fresh full record measured under the bf16-GEMM kernel revision
+        # (ops/flash_attention.KERNEL_REV). The pre-optimization r05 record
+        # carries no kernel_rev stamp, so reusing it can never satisfy this.
+        from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+
+        rec = last_json_record(res("bench_r05_tpu.json"))
+        if not (is_tpu_record(rec) and rec.get("value")):
+            return False
+        sub = rec.get("submetrics", {})
+        return ("captured_earlier" not in sub
+                and sub.get("kernel_rev") == KERNEL_REV
+                and any(row.get("batch") == 512
+                        for row in sub.get("batch_scaling", [])))
     raise SystemExit(f"unknown stage {stage!r}")
 
 

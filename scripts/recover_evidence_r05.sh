@@ -129,13 +129,68 @@ a200() {
 }
 run_stage apps200 "200px zero-shot apps" a200
 
+# stage 5 — re-measure the full record under the bf16-GEMM kernel revision
+# (ops/flash_attention.py KERNEL_REV, landed mid-round after stages 0-3 had
+# captured the f32-GEMM kernel). Writes to a temp file and promotes only on
+# bench success so a watchdog abort can never clobber the committed stage-2
+# record (which also backs the fullbench done-key); the pre-optimization
+# record stays in git history either way.
+bv2() {
+  # tmp lives at the repo root, NOT under results/ — commit_evidence's
+  # `git add -A results/` must never commit an un-promoted partial record
+  local tmp=.bench_r05_v2_tmp.json
+  if ! python bench.py --no-reuse --flash-block-sweep --skip-e2e \
+      > "$tmp" 2> results/bench_r05_v2.log; then
+    rm -f "$tmp"; return 1
+  fi
+  # Promote only a record that would satisfy stage_done('bench_v2') — same
+  # bar, checked BEFORE the mv: bench.py exits 0 both on its deliberate
+  # CPU-smoke fallback (wedged tunnel) and on a best-effort partial record
+  # (e.g. batch_scaling failed both attempts, r03-style), and neither may
+  # clobber the committed stage-2 TPU evidence. bv2 runs --skip-e2e (a
+  # same-session re-run would measure warm caches and overstate "cold"), so
+  # the stage-2 record's genuinely-cold e2e rows are carried into the
+  # promoted record, labeled.
+  if ! python - "$tmp" <<'PY'
+import json, sys
+from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+from ddim_cold_tpu.utils.record import is_tpu_record, last_json_record
+tmp = sys.argv[1]
+rec = last_json_record(tmp)
+sub = rec.get("submetrics", {}) if rec else {}
+ok = (is_tpu_record(rec) and rec.get("value")
+      and "captured_earlier" not in sub
+      and sub.get("kernel_rev") == KERNEL_REV
+      and any(r.get("batch") == 512 for r in sub.get("batch_scaling", [])))
+if not ok:
+    sys.exit(1)
+old = last_json_record("results/bench_r05_tpu.json")
+carried = {k: v for k, v in (old.get("submetrics", {}) if old else {}).items()
+           if k.startswith("e2e_")}
+if carried:
+    sub.update(carried)
+    sub["e2e_carried_from"] = (
+        "stage-2 record (cold-cache session); bench_v2 skips e2e because a "
+        "same-session re-run would measure warm caches — the kernel change "
+        "does not touch the e2e path")
+with open(tmp, "w") as f:
+    f.write(json.dumps(rec) + "\n")
+PY
+  then
+    note "bench_v2: record does not meet the stage bar — not promoting"
+    rm -f "$tmp"; return 1
+  fi
+  mv "$tmp" results/bench_r05_tpu.json
+}
+run_stage bench_v2 "full bench (bf16-GEMM kernel)" bv2
+
 # incomplete stages (tunnel died mid-chain)? re-arm the watcher, bounded.
 # Re-arm target is the REPO-OWNED script path (ADVICE r4 medium: a /tmp
 # path is both wiped by re-imaging and pre-creatable by other local users
 # on a shared host), and the chain refuses to arm a missing target.
 SELF="$REPO/scripts/recover_evidence_r05.sh"
 INCOMPLETE=0
-for s in northstar validate fullbench train200 apps200; do
+for s in northstar validate fullbench train200 apps200 bench_v2; do
   python scripts/r05_stage_done.py "$s" || INCOMPLETE=1
 done
 if [ "$INCOMPLETE" = 1 ] && [ "$A" -lt 5 ]; then
