@@ -28,7 +28,6 @@ import os
 import shutil
 import sys
 import tempfile
-import threading
 import time
 
 BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
@@ -218,6 +217,7 @@ def main(argv=None):
         args.ksweep = not args.smoke  # an explicit flag wins either way
 
     from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+    from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
     sub = {"kernel_rev": KERNEL_REV}
     # The record is assembled INCREMENTALLY and the watchdog below can emit it
@@ -241,16 +241,6 @@ def main(argv=None):
         "mfu": None,
         "submetrics": sub,
     }
-    progress = {"t": time.time(), "label": "backend init", "done": False}
-
-    def mark(label, budget_s=None):
-        """Liveness beacon. ``budget_s`` stretches the watchdog deadline for
-        the window AFTER this mark — known-long silent operations (a first
-        XLA/Mosaic compile of the 200px model can legitimately exceed the
-        default stall budget) must not be killed as wedged (ADVICE r3)."""
-        progress["t"], progress["label"] = time.time(), label
-        progress["budget"] = budget_s
-
     # Default: armed only when an accelerator platform is CONFIGURED — read
     # from jax.config, not a backend query: the watchdog must be running
     # before this process's own first jax.devices(), which is exactly the
@@ -270,42 +260,40 @@ def main(argv=None):
     stall_s = (float(env_stall) if env_stall is not None
                else 0.0 if effective_first_platform() == "cpu" else 1800.0)
 
-    def _watchdog():
-        emit_failures = 0
-        while not (progress["done"] or progress.get("disarmed")):
-            time.sleep(min(15.0, max(0.2, stall_s / 4)))  # outlive main()
-            idle = time.time() - progress["t"]
-            limit = max(stall_s, progress.get("budget") or 0.0)
-            if progress["done"] or idle <= limit:
-                continue
+    def _emit_partial(label, idle):
+        """Watchdog abort hook: the record (metadata + whatever sections
+        finished) goes out before the nonzero exit, then the e2e temp
+        dataset is removed (pure fs work _exit would otherwise skip)."""
+        for _ in range(3):  # retry a transient emit race, but NEVER loop
+            # forever: a process that can't emit (harness closed stdout)
+            # must still exit rather than sit holding the chip grant
             try:
                 # snapshot: the main thread may mutate sub mid-serialization
                 snap = dict(record, submetrics=dict(
                     sub,
                     aborted=f"no progress for {idle:.0f}s after "
-                            f"{progress['label']!r} — RPC wedged mid-run; "
+                            f"{label!r} — RPC wedged mid-run; "
                             "partial record emitted (raise "
                             "DDIM_COLD_BENCH_STALL_S to wait longer)"))
                 print(json.dumps(snap))
                 sys.stdout.flush()
-            except Exception:  # noqa: BLE001 — retry a transient emit race,
-                # but NEVER loop forever: a process that can't emit (harness
-                # closed stdout) must still exit rather than sit wedged
-                # holding the chip grant indefinitely
-                emit_failures += 1
-                if emit_failures < 3:
-                    continue
-            # best-effort cleanup _exit would otherwise skip (pure fs work,
-            # safe from this thread): the generated e2e dataset in /tmp
-            if _E2E_TMP["path"]:
-                shutil.rmtree(_E2E_TMP["path"], ignore_errors=True)
-            # _exit, nonzero: the record is out (or unemittable), callers
-            # must not log the partial run as success — and no signal ever
-            # reaches another client holding the chip grant
-            os._exit(3)
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        if _E2E_TMP["path"]:
+            shutil.rmtree(_E2E_TMP["path"], ignore_errors=True)
+        # StallWatchdog then os._exit(3)s: the record is out (or
+        # unemittable), callers must not log the partial run as success —
+        # and no signal ever reaches another client holding the chip grant
 
-    if stall_s > 0:
-        threading.Thread(target=_watchdog, daemon=True).start()
+    wd = StallWatchdog(stall_s, on_abort=_emit_partial, name="bench").start()
+
+    def mark(label, budget_s=None):
+        """Liveness beacon. ``budget_s`` stretches the watchdog deadline for
+        the window AFTER this mark — known-long silent operations (a first
+        XLA/Mosaic compile of the 200px model can legitimately exceed the
+        default stall budget) must not be killed as wedged (ADVICE r3)."""
+        wd.mark(label, budget_s)
     # everything below runs under the armed watchdog: the finally guarantees
     # it dies with main() even on an exception, so an in-process caller that
     # catches the exception is never os._exit'd by an orphaned watchdog
@@ -325,7 +313,7 @@ def main(argv=None):
             # override): same reasoning as the configured-cpu default above —
             # no tunnel to wedge, and heavy sections legitimately run for
             # hours on cpu. Disarm before they start.
-            progress["disarmed"] = True
+            wd.done()
         if platform_fallback:
             sub["platform_fallback"] = f"ran on cpu — {platform_fallback}"
         if jax.default_backend() == "cpu":
@@ -669,7 +657,7 @@ def main(argv=None):
         sys.stdout.flush()
         raise
     finally:
-        progress["done"] = True
+        wd.done()
 
 
 def _bench_e2e(args, model, state, log):

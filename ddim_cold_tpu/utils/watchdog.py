@@ -1,0 +1,85 @@
+"""Bounded-liveness guard for one-shot evidence scripts on the remote TPU.
+
+A dropped tunnel leaves the next XLA RPC blocked forever with no exception
+to catch — observed r03 (bench, fixed with bench.py's inline watchdog) and
+again r05 (`scripts/fid_trend.py`: 45 min flat I/O, SIGINT-immune, stage 4
+blocked behind it; results/tunnel_diag_r05.txt). A script that hangs until
+an outer kill records nothing, and killing a client that holds the chip
+grant is itself what wedges the tunnel (utils/platform.py) — so every
+chip-touching evidence script bounds its own silent windows and exits with
+a partial artifact instead.
+
+This is bench.py's beacon/watchdog pattern extracted for the smaller
+scripts (fid_trend, publish_run): call :meth:`mark` before every
+potentially-silent device interaction; a watchdog thread aborts the process
+(``on_abort`` then ``os._exit(exit_code)``) if no mark lands within the
+stall budget. ``os._exit`` is deliberate — the main thread is parked in a
+native call that will never re-enter the interpreter (r05: two SIGINTs
+delivered, neither KeyboardInterrupt ever fired), so cooperative shutdown
+cannot work.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+class StallWatchdog:
+    """Abort the process when no :meth:`mark` lands within ``stall_s``.
+
+    ``stall_s`` ≤ 0 disables the guard (CPU runs have no tunnel to wedge).
+    ``budget_s`` on a mark stretches the deadline for the single window
+    AFTER it — known-long silent operations (a first Mosaic compile at
+    N=2501 exceeds any sane default) must not be killed as wedged.
+    """
+
+    def __init__(self, stall_s: float, *, exit_code: int = 3,
+                 on_abort: Optional[Callable[[str, float], None]] = None,
+                 name: str = "watchdog"):
+        self.stall_s = float(stall_s)
+        self.exit_code = exit_code
+        self.on_abort = on_abort
+        self.name = name
+        self._state = {"t": time.time(), "label": "start", "budget": None,
+                       "done": False}
+        self._lock = threading.Lock()
+
+    def mark(self, label: str, budget_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._state.update(t=time.time(), label=label, budget=budget_s)
+
+    def done(self) -> None:
+        """Disarm — call when the script's artifact is fully written."""
+        with self._lock:
+            self._state["done"] = True
+
+    def start(self) -> "StallWatchdog":
+        if self.stall_s > 0:
+            threading.Thread(target=self._run, daemon=True).start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(min(15.0, max(0.05, self.stall_s / 4)))
+            with self._lock:
+                if self._state["done"]:
+                    return
+                limit = max(self.stall_s, self._state["budget"] or 0.0)
+                silent = time.time() - self._state["t"]
+                label = self._state["label"]
+            if silent > limit:
+                print(f"[{self.name}] STALL: no progress for {silent:.0f}s "
+                      f"(> {limit:.0f}s) after {label!r} — aborting with "
+                      f"partial artifact (wedged-tunnel guard)",
+                      file=sys.stderr, flush=True)
+                if self.on_abort is not None:
+                    try:
+                        self.on_abort(label, silent)
+                    except Exception as e:  # noqa: BLE001 — abort must abort
+                        print(f"[{self.name}] on_abort failed: {e!r}",
+                              file=sys.stderr, flush=True)
+                os._exit(self.exit_code)

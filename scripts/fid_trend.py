@@ -79,6 +79,7 @@ def main(argv=None):
     from ddim_cold_tpu.utils.platform import (
         honor_env_platform, require_accelerator_or_exit,
     )
+    from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
     honor_env_platform()
     import jax
@@ -106,7 +107,31 @@ def main(argv=None):
 
     points = collect_points(run_dir, args.max_points)
 
+    # -- wedged-tunnel guard (r05: this script hung 45 min on its first
+    # device interaction with nothing bounding it; tunnel_diag_r05.txt).
+    # Partial trend points are still an artifact — they order checkpoints.
+    run = os.path.basename(os.path.normpath(run_dir))
+    results = []
+
+    def _write_partial(label, silent_s):
+        # a DISTINCT filename: a stall must never clobber a previously
+        # complete fid_trend.json (same temp-then-promote discipline as the
+        # chain's bench_v2 stage)
+        out_dir = os.path.join(REPO, "results", run)
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "fid_trend.partial.json"), "w") as f:
+            json.dump({"metric": "fid_trend_cold", "points": results,
+                       "aborted": f"stalled {silent_s:.0f}s after {label!r} "
+                                  "(wedged-tunnel watchdog)"}, f, indent=1)
+
+    env_stall = os.environ.get("DDIM_COLD_FID_STALL_S")
+    stall_s = float(env_stall) if env_stall else (
+        0.0 if jax.config.jax_platforms == "cpu" else 600.0)
+    wd = StallWatchdog(stall_s, on_abort=_write_partial,
+                       name="fid-trend").start()
+
     # -- fixed extractor + shared real statistics ---------------------------
+    wd.mark("inception init (first device compile)", budget_s=1800)
     inc_model, inc_vars = inception.init_variables(
         jax.random.PRNGKey(args.inception_seed))
     feature_fn, dim = fid.make_feature_fn(inc_model, inc_vars)
@@ -120,6 +145,11 @@ def main(argv=None):
         for _, clean, _ in loader:
             if n_real_seen >= args.n_real:
                 break
+            # the first yielded batch triggers the jitted Inception forward
+            # compile (heavier than init_variables' compile) — it gets the
+            # long-compile budget, not the default window
+            wd.mark(f"real-batch {n_real_seen}/{args.n_real}",
+                    budget_s=1800 if n_real_seen == 0 else None)
             yield (clean + 1.0) / 2.0
             n_real_seen += clean.shape[0]
 
@@ -143,7 +173,7 @@ def main(argv=None):
                 lambda t, v: np.asarray(v, np.asarray(t).dtype), template, raw)
         return ckpt.restore_checkpoint(path, template)  # bestloss: bare params
 
-    results = []
+    first_sample = True
     for label, epoch, path in points:
         params = load_point(path)
         fake = fid.ActivationStats(dim)
@@ -151,6 +181,10 @@ def main(argv=None):
         while remaining > 0:  # full batches: one sampler compile (static shape)
             keep = min(args.batch, remaining)
             rng, sub = jax.random.split(rng)
+            wd.mark(f"sample-batch {label} {args.n_samples - remaining}"
+                    f"/{args.n_samples}",
+                    budget_s=1800 if first_sample else None)
+            first_sample = False
             imgs = sampling.cold_sample(model, params, sub, n=args.batch,
                                         levels=levels)
             fake.update(np.asarray(feature_fn(imgs))[:keep])
@@ -160,7 +194,7 @@ def main(argv=None):
                         "fid": round(float(value), 4)})
         print(f"[fid-trend] {label}: {value:.2f}", file=sys.stderr)
 
-    run = os.path.basename(os.path.normpath(run_dir))
+    wd.done()
     out = {
         "metric": "fid_trend_cold",
         "points": results,

@@ -77,7 +77,7 @@ def render_curve(ours: dict[int, float], ref: dict[int, float], path: str):
     plt.close(fig)
 
 
-def render_samples(run_dir: str, out_dir: str, *, n: int = 16):
+def render_samples(run_dir: str, out_dir: str, *, n: int = 16, wd=None):
     """Grids from the run's best checkpoint: DDIM samples + the 6-step cold
     sequence (the reference's two acceptance figures, ViT.py:283-305,
     ViT_draft2drawing.py:364-376)."""
@@ -96,10 +96,14 @@ def render_samples(run_dir: str, out_dir: str, *, n: int = 16):
     # 6 levels for 64px, 7 for the 200px config (same rule as compute_fid)
     levels = int(math.log2(config.image_size[0]))
     side = int(np.sqrt(n))
+    if wd is not None:  # first device op = the 200px sampler compile
+        wd.mark("sample grid (first sampler compile)", budget_s=1800)
     cold = np.asarray(sampling.cold_sample(
         model, params, jax.random.PRNGKey(0), n=side * side, levels=levels))
     save_grid(cold, os.path.join(out_dir, "samples.png"),
               nrows=side, ncols=side)
+    if wd is not None:  # n=4 differs from n=16: a second compile
+        wd.mark("sequence grid (second sampler compile)", budget_s=1800)
     seq = np.asarray(sampling.cold_sample(
         model, params, jax.random.PRNGKey(1), n=4, levels=levels,
         return_sequence=True))
@@ -144,7 +148,20 @@ def main(argv=None):
     render_curve(ours, ref, os.path.join(out_dir, "val_curve.png"))
 
     if not args.no_samples:
-        render_samples(args.run_dir, out_dir)
+        # wedged-tunnel guard for the mid-run RPCs require_accelerator's
+        # one-shot probe can't cover (r05: fid_trend hung exactly there) —
+        # the curves/logs above are already published; sampling is the only
+        # unbounded device work, so a stall still leaves a partial artifact
+        import jax
+
+        from ddim_cold_tpu.utils.watchdog import StallWatchdog
+
+        env_stall = os.environ.get("DDIM_COLD_FID_STALL_S")
+        stall_s = float(env_stall) if env_stall else (
+            0.0 if jax.config.jax_platforms == "cpu" else 600.0)
+        wd = StallWatchdog(stall_s, name="publish-run").start()
+        render_samples(args.run_dir, out_dir, wd=wd)
+        wd.done()
 
     summary = {
         "run": run,

@@ -47,6 +47,11 @@ commit_evidence() { # $1 = message
   fi
 }
 
+# settle window between chip clients: the r05 wedge hit the client that
+# connected the same second the previous one disconnected (grant-handoff
+# race, results/tunnel_diag_r05.txt) — give the relay a beat to release
+SETTLE=${DDIM_COLD_STAGE_SETTLE:-10}
+
 run_stage() { # $1 = stage key, $2 = label, $3... = command
   local key=$1 label=$2; shift 2
   if python scripts/r05_stage_done.py "$key"; then
@@ -54,6 +59,7 @@ run_stage() { # $1 = stage key, $2 = label, $3... = command
     return 0
   fi
   note "$label: start"
+  sleep "$SETTLE"
   if "$@" >>"$LOG" 2>&1; then
     note "$label: OK"
   else
@@ -91,7 +97,9 @@ t200() {
       --train 4096 --val 512 || return $?
   fi
   python multi_gpu_trainer.py 20220822_200px || return $?
+  sleep "$SETTLE"  # grant-handoff settle between chip clients (see above)
   python scripts/publish_run.py Saved_Models/20220822_200pxflower200_diffusion || return $?
+  sleep "$SETTLE"
   python scripts/fid_trend.py Saved_Models/20220822_200pxflower200_diffusion \
     || note "fid_trend FAILED rc=$? (best-effort)"
   return 0
