@@ -404,6 +404,9 @@ def block_template(model: "DiffusionViT", *, seq_manual_axis=None,
         sp_mode=model.sp_mode,
         seq_manual=seq_manual_axis is not None, seq_axis=seq_manual_axis,
         seq_valid_len=seq_valid_len, seq_varying_axes=seq_varying_axes,
+        num_experts=model.num_experts,
+        moe_capacity_factor=model.moe_capacity_factor,
+        moe_dispatch=model.moe_dispatch,
     )
 
 
@@ -501,9 +504,10 @@ class DiffusionViT(nn.Module):
     # leading layer axis (O(1) compile in depth; pipeline-parallel substrate)
     num_experts: int = 1  # >1: Switch-MoE MLP per block (models/moe.py);
     # expert params shard over an 'expert' mesh axis. Composes with
-    # scan_blocks (the scan stacks the sown aux losses on the layer axis);
-    # still not composable with pipe (the pipeline executor applies the
-    # block template functionally and drops sown collections).
+    # scan_blocks (the scan stacks the sown aux losses on the layer axis)
+    # AND with pipe (the pipeline stage body re-sows: each block call's aux
+    # is accumulated across the schedule, bubble steps masked, and returned
+    # through the pipelined apply's mutable=["losses"] path — pipeline.py).
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # see models/moe.py: "index" removes the
     # O(N^2*cf) one-hot dispatch tensors (long-sequence configs)

@@ -24,7 +24,10 @@ def layout_for_mesh(model, mesh: Mesh, params, *,
     )
 
     if int(mesh.shape.get("pipe", 1)) > 1:
-        tensor_axes = tuple(a for a in ("model",)
+        # 'expert' rides along like 'model': both stay GSPMD-auto inside the
+        # pipeline's manual region, so MoE expert banks Megatron-shard the
+        # same way tp kernels do (pipe×ep, the last composition gap)
+        tensor_axes = tuple(a for a in ("model", "expert")
                             if int(mesh.shape.get(a, 1)) > 1)
         return (pipeline_param_specs(params, tensor_axes=tensor_axes),
                 make_pipelined_apply(model, mesh, n_microbatch=n_microbatch))

@@ -59,6 +59,7 @@ def pipeline_blocks(
     dropout_rng: Optional[jax.Array] = None,
     remat: bool = False,
     check_vma: bool = True,
+    with_aux: bool = False,
 ) -> jax.Array:
     """Run the transformer trunk through the pipeline.
 
@@ -74,6 +75,14 @@ def pipeline_blocks(
     padded to a multiple of the axis size here and unpadded on return; the
     pad positions are masked inside the ring via the template's
     ``seq_valid_len``.
+
+    ``with_aux`` (pipe×MoE): returns ``(tokens, aux)`` where ``aux`` is the
+    mean of every sown 'losses' scalar across (layer, microbatch, seq shard)
+    — the pipeline equivalent of the plain path's layer-stacked ``moe_aux``
+    (train/step.py normalizes by element count, so the pre-normalized mean
+    slots in unchanged). Bubble-step applications are masked out: their
+    tokens are garbage and their router stats would bias the load-balance
+    term. Per data shard, shape (1,), P(batch_axis) — callers mean over it.
     """
     n_stages = int(mesh.shape[axis])
     depth = int(jax.tree.leaves(stacked_params)[0].shape[0])
@@ -96,6 +105,15 @@ def pipeline_blocks(
                 "template — build it with block_template(model, "
                 "seq_manual_axis=...)")
         n_pad = (-N) % int(mesh.shape[seq_axis])
+        if n_pad and getattr(block, "num_experts", 1) > 1:
+            # seq_valid_len masks pads inside ATTENTION only; the Switch
+            # router would still see the zero rows — they consume expert
+            # capacity (dropping real tokens' updates) and bias the sown
+            # load-balance stats. Fail loud instead of silently degrading.
+            raise ValueError(
+                f"pipe×sp×MoE needs the token count ({N}) divisible by the "
+                f"'{seq_axis}' axis ({int(mesh.shape[seq_axis])}): ring "
+                "padding would route zero tokens through the Switch router")
         if n_pad:
             tokens = jnp.pad(tokens, [(0, 0), (0, n_pad), (0, 0)])
 
@@ -106,10 +124,32 @@ def pipeline_blocks(
     mb = tokens.reshape((M, B // M) + tokens.shape[1:])
 
     use_rng = dropout_rng is not None
+    # every manual axis the aux scalar ends up varying over (params vary per
+    # pipe stage, tokens per data/seq shard) — scan carry inits must be
+    # pcast to the same vma type as the loop output or shard_map's typing
+    # rejects the scan (same rule as the schedule buffers below)
+    aux_axes = tuple(a for a in (axis, batch_axis, seq_axis) if a is not None)
+
+    # element count a single block call sows, captured at trace time — the
+    # normalization must count sown ELEMENTS like train/step.py's plain path
+    # (n_vals = Σ s.size), not block calls, or a block that one day sows a
+    # second scalar (router z-loss) would silently double the pipelined aux
+    # relative to the plain layout
+    sown_per_call = [1]
 
     def apply_block(p, tok, rate, rngs):
-        return block.apply({"params": p}, tok, deterministic,
-                           dp_rate=rate, rngs=rngs)
+        # mutable=["losses"] unconditionally: dense blocks sow nothing (aux
+        # stays 0 and XLA drops the dead adds); MoE blocks sow their Switch
+        # load-balance scalar, which the schedule below accumulates instead
+        # of dropping (the pre-r05 guard refused MoE here for exactly that)
+        tok, aux_vars = block.apply({"params": p}, tok, deterministic,
+                                    dp_rate=rate, rngs=rngs,
+                                    mutable=["losses"])
+        sown = jax.tree.leaves(aux_vars.get("losses", {}))
+        aux = (sum(jnp.sum(s) for s in sown).astype(jnp.float32)
+               if sown else jnp.zeros((), jnp.float32))
+        sown_per_call[0] = max(1, sum(int(s.size) for s in sown))
+        return tok, aux
 
     if remat:
         apply_block = jax.checkpoint(apply_block)
@@ -130,8 +170,9 @@ def pipeline_blocks(
         n_data = int(mesh.shape.get(batch_axis, 1)) if batch_axis is not None else 1
 
         def stage_apply(tok, step_i):
-            """One stage = scan over its bps local blocks."""
-            def body(tok, xs):
+            """One stage = scan over its bps local blocks; sown aux summed."""
+            def body(carry, xs):
+                tok, aux = carry
                 p, rate, j = xs
                 rngs = None
                 if use_rng:
@@ -142,11 +183,14 @@ def pipeline_blocks(
                     key = jax.random.fold_in(
                         rng[0], (step_i * depth + s * bps + j) * n_data + d)
                     rngs = {"dropout": key}
-                tok = apply_block(p, tok, rate, rngs)
-                return tok, None
+                tok, a = apply_block(p, tok, rate, rngs)
+                return (tok, aux + a), None
 
-            tok, _ = jax.lax.scan(body, tok, (params_s, dpr_s, jnp.arange(bps)))
-            return tok
+            aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), aux_axes,
+                                 to="varying")
+            (tok, aux), _ = jax.lax.scan(
+                body, (tok, aux0), (params_s, dpr_s, jnp.arange(bps)))
+            return tok, aux
 
         T = M + n_stages - 1
         # accumulators must be typed varying over the pipe axis too (values
@@ -155,17 +199,22 @@ def pipeline_blocks(
         vary = lambda z: jax.lax.pcast(z, (axis,), to="varying")
         out_buf = vary(jnp.zeros_like(mb_all))
         buf = vary(jnp.zeros_like(mb_all[0]))
+        aux_acc = jax.lax.pcast(jnp.zeros((), jnp.float32), aux_axes,
+                                to="varying")
 
         def step(carry, i):
-            buf, out_buf = carry
+            buf, out_buf, aux_acc = carry
             # stage 0 injects microbatch i; later stages consume the ring buffer
             inject = mb_all[jnp.clip(i, 0, M - 1)]
             cur = jnp.where(s == 0, inject, buf)
-            y = stage_apply(cur, i)
+            y, aux_step = stage_apply(cur, i)
             # bubble steps (this stage has no live microbatch) pass input
             # through unchanged — keeps values bounded, result is discarded
+            # (and the bubble's sown aux with it: garbage-token router stats
+            # would bias the load-balance mean)
             active = (i - s >= 0) & (i - s < M)
             y = jnp.where(active, y, cur)
+            aux_acc = aux_acc + jnp.where(active, aux_step, 0.0)
             # last stage banks its finished microbatch
             out_idx = i - (n_stages - 1)
             collect = (s == n_stages - 1) & (out_idx >= 0) & (out_idx < M)
@@ -174,12 +223,23 @@ def pipeline_blocks(
             out_buf = jnp.where(collect, banked, out_buf)
             perm = [(d, (d + 1) % n_stages) for d in range(n_stages)]
             buf = jax.lax.ppermute(y, axis, perm)
-            return (buf, out_buf), None
+            return (buf, out_buf, aux_acc), None
 
-        (buf, out_buf), _ = jax.lax.scan(step, (buf, out_buf), jnp.arange(T))
+        (buf, out_buf, aux_acc), _ = jax.lax.scan(
+            step, (buf, out_buf, aux_acc), jnp.arange(T))
         # replicate the last stage's outputs to every stage (zeros elsewhere)
         out = jnp.where(s == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
-        return jax.lax.psum(out, axis)
+        out = jax.lax.psum(out, axis)
+        if not with_aux:
+            return out
+        # mean over every sown scalar: psum folds the per-stage (and per-seq-
+        # shard) sums, each active (stage, step) contributed bps block sows
+        aux = jax.lax.psum(aux_acc, axis)
+        n_sown = depth * M * sown_per_call[0]
+        if seq_axis is not None:
+            aux = jax.lax.psum(aux, seq_axis)
+            n_sown *= int(mesh.shape[seq_axis])
+        return out, aux[None] / n_sown
 
     tok_spec = P(None, batch_axis, seq_axis, None)
     rng_arg = (dropout_rng if use_rng else jax.random.PRNGKey(0))[None]
@@ -194,13 +254,17 @@ def pipeline_blocks(
         per_device,
         mesh=mesh,
         in_specs=(P(axis), P(axis), tok_spec, P()),
-        out_specs=tok_spec,
+        out_specs=(tok_spec, P(batch_axis)) if with_aux else tok_spec,
         axis_names=frozenset(manual),
         check_vma=check_vma,
     )
-    out = fn(stage_params, dpr_st, mb, rng_arg)
+    if with_aux:
+        out, aux = fn(stage_params, dpr_st, mb, rng_arg)
+    else:
+        out = fn(stage_params, dpr_st, mb, rng_arg)
     out = out.reshape(tokens.shape)
-    return out[:, :N]  # drop ring padding (no-op when seq_axis is None)
+    out = out[:, :N]  # drop ring padding (no-op when seq_axis is None)
+    return (out, aux) if with_aux else out
 
 
 def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
@@ -219,16 +283,6 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
     rule as every sequence-parallel path)."""
     if not model.scan_blocks:
         raise ValueError("pipelined apply requires scan_blocks=True")
-    if getattr(model, "num_experts", 1) > 1:
-        # the stage body applies the dense block_template (no MoE fields):
-        # a MoE model would fail deep inside the shard_map with a missing-
-        # param error and silently drop its sown aux loss. Same rule the
-        # trainer enforces for pipe meshes — guarded here too because this
-        # is a public API entry (MoE×scan_blocks WITHOUT pipe composes fine).
-        raise ValueError(
-            "pipeline parallelism does not compose with num_experts > 1 "
-            "(the pipeline stage body drops sown collections) — use an "
-            "'expert' mesh axis instead")
     if model.seq_axis is not None or model.head_axis is not None:
         # composition is mesh-driven HERE, not via model fields: a model
         # built with the global-collective sp/tp attention would nest a
@@ -265,7 +319,32 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
         block = block_template(model)
     dpr = np.linspace(0.0, model.drop_path_rate, model.depth)
 
-    def apply_fn(variables, x, t, deterministic: bool = True, rngs=None):
+    def apply_fn(variables, x, t, deterministic: bool = True, rngs=None,
+                 mutable=None):
+        """``mutable=["losses"]`` mirrors ``model.apply``'s MoE contract
+        (pipe×MoE): returns ``(out, {"losses": {"moe_aux": aux}})`` where
+        ``aux`` is the per-data-shard mean of the sown Switch scalars —
+        train/step.py's sum/size normalization then reproduces the plain
+        path's aux term. The stage body re-sows what the shard_map would
+        otherwise drop (pipeline_blocks ``with_aux``)."""
+        # normalize flax's accepted mutable forms (str | bool | iterable);
+        # collections this apply can't thread fail LOUD — silently dropping
+        # a requested collection would corrupt the caller's unpack
+        if mutable is None or mutable is False:
+            cols = None
+        elif mutable is True:
+            cols = ("losses",)  # the only collection the trunk sows
+        elif isinstance(mutable, str):
+            cols = (mutable,)
+        else:
+            cols = tuple(mutable)
+        if cols:
+            unsupported = [c for c in cols if c != "losses"]
+            if unsupported:
+                raise ValueError(
+                    f"pipelined apply threads only the 'losses' collection, "
+                    f"got mutable={list(cols)!r}")
+        want_losses = bool(cols) and "losses" in cols
         params = variables["params"]
         dropout_rng = (rngs or {}).get("dropout")
         tokens = model.apply({"params": params}, x, t, stage="embed",
@@ -275,9 +354,19 @@ def make_pipelined_apply(model, mesh: Mesh, *, axis: str = "pipe",
             axis=axis, batch_axis=batch_axis, seq_axis=seq_axis,
             n_microbatch=n_microbatch,
             deterministic=deterministic, dropout_rng=dropout_rng,
-            remat=model.remat, check_vma=check_vma,
+            remat=model.remat, check_vma=check_vma, with_aux=want_losses,
         )
-        return model.apply({"params": params}, x, t, stage="head",
-                           tokens=tokens, deterministic=deterministic, rngs=rngs)
+        if want_losses:
+            tokens, aux = tokens
+        out = model.apply({"params": params}, x, t, stage="head",
+                          tokens=tokens, deterministic=deterministic, rngs=rngs)
+        if want_losses:
+            return out, {"losses": {"moe_aux": aux}}
+        if cols is not None:  # mutable=[] is valid flax: keep the 2-tuple arity
+            return out, {}
+        return out
 
+    # the train step keys its mutable=["losses"] MoE path off this flag —
+    # a plain custom apply_fn without it still gets the fail-loud refusal
+    apply_fn.supports_losses = True
     return apply_fn

@@ -120,10 +120,12 @@ def make_train_step(model, apply_fn: Optional[Callable] = None,
     same batch — the gap is entirely the tunnel link, not compute).
     """
     moe_on = moe_aux_weight > 0 and getattr(model, "num_experts", 1) > 1
-    if moe_on and apply_fn is not None:
+    if (moe_on and apply_fn is not None
+            and not getattr(apply_fn, "supports_losses", False)):
         raise ValueError(
-            "moe_aux_weight requires the plain model.apply path (custom "
-            "apply_fn hooks don't thread the 'losses' collection)")
+            "moe_aux_weight requires an apply path that threads the "
+            "'losses' collection — model.apply, or a custom apply_fn that "
+            "sets .supports_losses (e.g. make_pipelined_apply)")
     apply_fn = apply_fn or model.apply
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")

@@ -211,13 +211,6 @@ def build_model(config: ExperimentConfig, mesh=None) -> DiffusionViT:
         if "seq" in mesh_shape:
             kwargs["attn_drop_rate"] = 0.0  # manual sp: same dropout rule
             kwargs["sp_mode"] = config.sp_mode
-    if config.num_experts > 1 and "pipe" in mesh_shape:
-        raise ValueError(
-            "num_experts > 1 does not compose with pipeline parallelism "
-            "(the pipeline executor applies the block template functionally "
-            "and drops sown collections, losing the MoE aux loss; plain "
-            "scan_blocks composes fine) — use an 'expert' (and 'data') "
-            "mesh axis instead")
     if "seq" in mesh_shape and "pipe" not in mesh_shape:
         # pure-sp meshes ({seq: N}, no data axis) replicate the batch; with a
         # tp axis the ring keeps heads sharded over it (no qkv all-gather)
