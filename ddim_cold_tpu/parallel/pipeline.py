@@ -104,16 +104,24 @@ def pipeline_blocks(
                 "seq_axis is set but `block` is not the manual-ring "
                 "template — build it with block_template(model, "
                 "seq_manual_axis=...)")
-        n_pad = (-N) % int(mesh.shape[seq_axis])
-        if n_pad and getattr(block, "num_experts", 1) > 1:
-            # seq_valid_len masks pads inside ATTENTION only; the Switch
-            # router would still see the zero rows — they consume expert
-            # capacity (dropping real tokens' updates) and bias the sown
-            # load-balance stats. Fail loud instead of silently degrading.
+        if getattr(block, "num_experts", 1) > 1:
+            # Inside the pipeline's manual region the WHOLE block — MLP
+            # included — sees only its seq shard, so Switch capacity and
+            # routing priority become shard-local: an expert can drop tokens
+            # the unsharded model would keep (and ring-pad zeros would eat
+            # capacity too). Every other layout reproduces the unsharded
+            # step (the dryrun equivalence net's standard); a silently
+            # different routing function fails that bar, so the pp×sp×MoE
+            # TRIPLE is refused. All PAIRS compose: pp×ep (this module),
+            # pp×sp (dense blocks), sp×ep (the global-collective wrapper,
+            # where the MLP stays in GSPMD-land with the full token view).
             raise ValueError(
-                f"pipe×sp×MoE needs the token count ({N}) divisible by the "
-                f"'{seq_axis}' axis ({int(mesh.shape[seq_axis])}): ring "
-                "padding would route zero tokens through the Switch router")
+                "pipeline×sequence parallelism does not compose with "
+                "num_experts > 1: the stage body would route each seq "
+                "shard's tokens through shard-local Switch capacity, "
+                "silently diverging from the unsharded model — drop the "
+                f"'{seq_axis}' axis or use the {{data, seq, expert}} mesh")
+        n_pad = (-N) % int(mesh.shape[seq_axis])
         if n_pad:
             tokens = jnp.pad(tokens, [(0, 0), (0, n_pad), (0, 0)])
 

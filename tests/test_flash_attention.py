@@ -131,6 +131,25 @@ def test_flash_gradient_north_star_shape_matches_dense():
                                    rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
 
 
+def test_flash_bf16_north_star_headline_config_matches_dense():
+    """The EXACT path bench_v2 measures on chip: bf16 inputs, N=2501, H=4,
+    D=64, the tuned NS_FLASH_BLOCKS single-chunk config — against the dense
+    f32 oracle on the same bf16 inputs. The bf16-gemm-v2 kernel runs its
+    GEMMs in bf16 here (input dtype), so this pins the numerics of the
+    production sampler configuration, not just the f32 test shapes."""
+    from bench import NS_FLASH_BLOCKS
+
+    q32, k32, v32 = _rand_qkv(17, 1, 2501, 4, 64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+    scale = 64**-0.5
+    out = flash_attention(q, k, v, scale, *NS_FLASH_BLOCKS)
+    assert out.dtype == jnp.bfloat16
+    want = _dense_attention_f32(q, k, v, scale)[1]
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_model_use_flash_parity():
     """DiffusionViT(use_flash=True) ≡ the einsum model in eval mode — same
     params tree (flash adds no parameters), same outputs."""

@@ -260,11 +260,12 @@ def test_pipelined_moe_grads_with_aux_finite():
     assert any(float(np.abs(np.asarray(g)).max()) > 0 for g in moe_grads)
 
 
-def test_pipelined_moe_mutable_forms_and_sp_pad_refusal():
+def test_pipelined_moe_mutable_forms_and_sp_refusal():
     """Edge contracts: every flax-legal ``mutable`` form keeps the 2-tuple
-    arity (or fails loud for collections the pipeline can't thread), and
-    pipe×sp×MoE with ring padding is refused — zero pad tokens would consume
-    Switch expert capacity and bias the sown load-balance stats."""
+    arity (or fails loud for collections the pipeline can't thread), and the
+    pp×sp×MoE TRIPLE is refused — the stage body would give each seq shard
+    its own Switch capacity/priority, silently diverging from the unsharded
+    routing every other layout reproduces."""
     model = DiffusionViT(scan_blocks=True, num_experts=2, **CFG)
     x = jnp.asarray(np.random.RandomState(3).randn(4, 16, 16, 3), jnp.float32)
     t = jnp.array([1, 5, 9, 100], jnp.int32)
@@ -281,11 +282,10 @@ def test_pipelined_moe_mutable_forms_and_sp_pad_refusal():
     with pytest.raises(ValueError, match="only the 'losses'"):
         pf({"params": params}, x, t, mutable=["losses", "intermediates"])
 
-    # (16/4)^2 + 1 = 17 tokens, indivisible by seq 2 → MoE refusal
     sp_model = DiffusionViT(scan_blocks=True, num_experts=2, **CFG)
     sp_mesh = make_mesh({"pipe": 2, "seq": 2}, devices=jax.devices()[:4])
     spf = make_pipelined_apply(sp_model, sp_mesh)
-    with pytest.raises(ValueError, match="divisible"):
+    with pytest.raises(ValueError, match="shard-local Switch capacity"):
         spf({"params": params}, x, t)
 
 
