@@ -69,6 +69,24 @@ def stage_done(stage: str) -> bool:
     if stage == "apps200":
         return (os.path.exists(res(RUN200, "draft2img.png"))
                 and os.path.exists(res(RUN200, "interpolation.png")))
+    if stage == "validate_v2":
+        # on-chip numerics re-validated under the bf16-GEMM kernel revision.
+        # The morning r05 validate ran the pre-optimization kernel (its file
+        # carries no kernel_rev stamp) — but a chain where stage 1 itself
+        # runs post-revision writes a stamped tpu_validate_r05.txt, which is
+        # byte-identical work this stage must not re-burn chip time on.
+        from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+
+        for fname in ("tpu_validate_r05b.txt", "tpu_validate_r05.txt"):
+            try:
+                with open(res(fname)) as f:
+                    body = f.read()
+            except OSError:
+                continue
+            if ("tpu_validate: ALL OK" in body
+                    and f"kernel_rev={KERNEL_REV}" in body):
+                return True
+        return False
     if stage == "bench_v2":
         # fresh full record measured under the bf16-GEMM kernel revision
         # (ops/flash_attention.KERNEL_REV). The pre-optimization r05 record

@@ -137,13 +137,27 @@ a200() {
 }
 run_stage apps200 "200px zero-shot apps" a200
 
-# stage 5 — re-measure the full record under the bf16-GEMM kernel revision
+# stage 5a — re-validate on-chip numerics under the bf16-GEMM kernel
+# revision (ops/flash_attention.py KERNEL_REV): interpret mode proved the
+# math CPU-side; only hardware proves the Mosaic lowering computes the same
+# numbers, and this is 7 min vs the 20-min bench it gates.
+val2() { python scripts/tpu_validate.py --no-bench > results/tpu_validate_r05b.txt 2>&1; }
+run_stage validate_v2 "tpu_validate (bf16-GEMM kernel)" val2
+
+# stage 5b — re-measure the full record under the bf16-GEMM kernel revision
 # (ops/flash_attention.py KERNEL_REV, landed mid-round after stages 0-3 had
 # captured the f32-GEMM kernel). Writes to a temp file and promotes only on
 # bench success so a watchdog abort can never clobber the committed stage-2
 # record (which also backs the fullbench done-key); the pre-optimization
 # record stays in git history either way.
 bv2() {
+  # HARD gate on the numerics re-validate: a kernel whose on-chip numerics
+  # just failed (or never ran) must not produce a record that replaces the
+  # committed numerics-valid stage-2 evidence
+  if ! python scripts/r05_stage_done.py validate_v2; then
+    note "bench_v2: blocked — validate_v2 has not passed for this kernel rev"
+    return 1
+  fi
   # tmp lives at the repo root, NOT under results/ — commit_evidence's
   # `git add -A results/` must never commit an un-promoted partial record
   local tmp=.bench_r05_v2_tmp.json
@@ -198,7 +212,7 @@ run_stage bench_v2 "full bench (bf16-GEMM kernel)" bv2
 # on a shared host), and the chain refuses to arm a missing target.
 SELF="$REPO/scripts/recover_evidence_r05.sh"
 INCOMPLETE=0
-for s in northstar validate fullbench train200 apps200 bench_v2; do
+for s in northstar validate fullbench train200 apps200 validate_v2 bench_v2; do
   python scripts/r05_stage_done.py "$s" || INCOMPLETE=1
 done
 if [ "$INCOMPLETE" = 1 ] && [ "$A" -lt 5 ]; then

@@ -45,12 +45,19 @@ def main():
     from ddim_cold_tpu.ops import sampling
     from ddim_cold_tpu.train.step import create_train_state, make_train_step
 
-    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()} "
+          f"kernel_rev={KERNEL_REV}")
     if jax.default_backend() == "cpu":
         print("WARNING: running on CPU — numbers are not TPU numbers")
 
     # -- 1. fused-attention numerics on-chip (64px + 200px shapes): the
-    # Pallas kernel AND the pure-XLA blockwise path, each vs dense ---------
+    # Pallas kernel AND the pure-XLA blockwise path, each vs dense. The
+    # 200px flash leg runs the bench's tuned headline blocks so the parity
+    # check covers the EXACT kernel configuration the record measures -----
+    from bench import NS_FLASH_BLOCKS
+
     for name in ("vit_tiny",) + (() if args.quick else ("oxford_flower_200_p4",)):
         cfg = MODEL_CONFIGS[name]
         dense_m = DiffusionViT(dtype=jnp.bfloat16, **cfg)
@@ -60,7 +67,11 @@ def main():
         params = dense_m.init(jax.random.PRNGKey(1), x, t)["params"]
         a = np.asarray(dense_m.apply({"params": params}, x, t))
         for impl, label in ((True, "flash"), ("xla", "xla")):
-            m = DiffusionViT(dtype=jnp.bfloat16, use_flash=impl, **cfg)
+            blocks = (NS_FLASH_BLOCKS
+                      if impl is True and name == "oxford_flower_200_p4"
+                      else None)
+            m = DiffusionViT(dtype=jnp.bfloat16, use_flash=impl,
+                             flash_blocks=blocks, **cfg)
             b = np.asarray(m.apply({"params": params}, x, t))
             err = np.abs(a - b).max()
             ok = err < 0.05  # bf16 blockwise-vs-dense softmax tolerance
@@ -89,7 +100,11 @@ def main():
         # the 20-step bf16 sampler accumulation at 200px, both attention paths
         # (bench only times these — numerics are asserted here)
         for flash in (False, True, "xla"):
+            # the flash leg samples under the bench's tuned headline blocks
+            # so the accumulation is asserted at the measured configuration
             m2 = DiffusionViT(dtype=jnp.bfloat16, use_flash=flash,
+                              flash_blocks=(NS_FLASH_BLOCKS
+                                            if flash is True else None),
                               **MODEL_CONFIGS["oxford_flower_200_p4"])
             p2 = m2.init(jax.random.PRNGKey(0), jnp.zeros((1, 200, 200, 3)),
                          jnp.zeros((1,), jnp.int32))["params"]
