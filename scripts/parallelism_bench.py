@@ -106,6 +106,11 @@ def main(argv=None):
                                          "model": 2}, {}),
             f"dp{n//4}_seq2_tp2_ul": ({"data": n // 4, "seq": 2, "model": 2},
                                       {"sp_mode": "ulysses"}),
+            # pipe×ep (round 5): expert banks GSPMD-auto inside the manual
+            # pipe region, aux re-sown through the schedule; ratios against
+            # the same-model moe_dp row like every MoE layout
+            f"moe_dp{n//4}_pipe2_ep2": ({"data": n // 4, "pipe": 2,
+                                         "expert": 2}, {"num_experts": 4}),
         })
 
     rng = np.random.RandomState(0)
