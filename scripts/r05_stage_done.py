@@ -69,6 +69,16 @@ def stage_done(stage: str) -> bool:
     if stage == "apps200":
         return (os.path.exists(res(RUN200, "draft2img.png"))
                 and os.path.exists(res(RUN200, "interpolation.png")))
+    if stage == "fid200":
+        # the 200px FID trend — stage 3's best-effort tail died in the r05
+        # wedge (tunnel_diag_r05.txt) and train200's done-key (epochs >= 8)
+        # rightly doesn't cover it, so it gets its own stage + watchdog
+        try:
+            with open(res(RUN200, "fid_trend.json")) as f:
+                rec = json.load(f)
+            return "aborted" not in rec and bool(rec.get("points"))
+        except Exception:
+            return False
     if stage == "validate_v2":
         # on-chip numerics re-validated under the bf16-GEMM kernel revision.
         # The morning r05 validate ran the pre-optimization kernel (its file
