@@ -151,6 +151,12 @@ def main(argv=None):
                     help="sweep sampler stride k (BASELINE.json's k-sweep "
                          "config). Default: on, except under --smoke; pass "
                          "--ksweep/--no-ksweep to force either way")
+    ap.add_argument("--profile-northstar", action="store_true",
+                    help="capture a jax.profiler trace of ONE tuned-blocks "
+                         "flash sampling run into results/profile_northstar/ "
+                         "(best-effort; the evidence for the NEXT kernel "
+                         "optimization round — says where the remaining "
+                         "sampler time goes once the GEMMs are bf16)")
     ap.add_argument("--flash-block-sweep", action="store_true",
                     help="in the north-star section, additionally time the "
                          "flash kernel under alternative (block_q, block_kv) "
@@ -640,6 +646,38 @@ def main(argv=None):
 
         if not args.skip_northstar:
             section("northstar", run_northstar)
+
+        def run_northstar_profile():
+            # one traced tuned-blocks flash sampling run (n=16, k=20): the
+            # timeline that says where the remaining sampler time goes. The
+            # model/params/compile are memoized from the northstar section;
+            # the trace adds one extra timed-path execution of chip time.
+            from ddim_cold_tpu.ops import sampling
+
+            prof_model = DiffusionViT(
+                dtype=jnp.bfloat16, use_flash=True,
+                flash_blocks=NS_FLASH_BLOCKS,
+                **MODEL_CONFIGS["oxford_flower_200_p4"])
+            prof_params = prof_model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
+            # warm the compile outside the trace window
+            np.asarray(sampling.ddim_sample(
+                prof_model, prof_params, jax.random.PRNGKey(2), k=20, n=16))
+            out_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "results", "profile_northstar")
+            mark("north-star profile trace", budget_s=600)
+            with jax.profiler.trace(out_dir):
+                np.asarray(sampling.ddim_sample(
+                    prof_model, prof_params, jax.random.PRNGKey(3), k=20, n=16))
+            sub["northstar_profile"] = {"dir": "results/profile_northstar"}
+
+        if args.profile_northstar and not args.skip_northstar:
+            # best-effort: a profiler failure on the tunnel backend must not
+            # cost the record (retries=0 — a second multi-GB trace attempt
+            # would double the chip time for a nice-to-have)
+            section("northstar_profile", run_northstar_profile, retries=0)
 
         # ------------------------------------------------- e2e with the data path
         if not args.skip_e2e:
