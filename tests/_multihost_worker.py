@@ -80,6 +80,10 @@ def main():
         run_dptpsp(jax, jnp, out_dir, proc_id)
         jax.distributed.shutdown()
         return
+    if mode == "pipemoe":
+        run_pipemoe(jax, jnp, out_dir, proc_id)
+        jax.distributed.shutdown()
+        return
     assert jax.local_device_count() == 4, jax.local_device_count()
 
     mesh = make_mesh({"data": jax.device_count()})
@@ -182,6 +186,68 @@ def run_dptpsp(jax, jnp, out_dir: str, proc_id: int):
     state, gloss, _ = g_step(state, gbatch, jax.random.PRNGKey(1),
                              jnp.float32(5.0))
     assert np.isfinite(float(gloss)), gloss
+
+    with open(os.path.join(out_dir, f"loss_{proc_id}.txt"), "w") as f:
+        f.write(repr(loss))
+
+
+def run_pipemoe(jax, jnp, out_dir: str, proc_id: int):
+    """GPipe ACROSS PROCESSES + the pipe×MoE aux path (round 5): mesh
+    {pipe: 2, data: 2} over 2 processes × 2 local devices puts stage 0 on
+    process 0 and stage 1 on process 1, so every schedule ppermute and the
+    aux psum cross the DCN boundary; the Switch aux loss rides the
+    pipelined apply's mutable=["losses"] path into the step objective."""
+    from ddim_cold_tpu.models import DiffusionViT
+    from ddim_cold_tpu.parallel import (
+        make_mesh, make_pipelined_apply, pipeline_param_specs,
+        shard_batch, shard_train_state,
+    )
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    assert jax.local_device_count() == 2, jax.local_device_count()
+    mesh = make_mesh({"pipe": 2, "data": 2})
+    # the claim under test is GPipe ppermute CROSSING the process boundary:
+    # this process must own exactly one pipe stage (and hence span both data
+    # shards). If device enumeration ever stops being process-major, fail
+    # loud here instead of green-lighting a vacuous single-process pipeline.
+    pipe_ax = list(mesh.axis_names).index("pipe")
+    stages = {
+        int(np.argwhere(np.asarray(mesh.devices) == d)[0][pipe_ax])
+        for d in mesh.local_devices
+    }
+    assert len(stages) == 1, (
+        f"process spans pipe stages {sorted(stages)} — the DCN-crossing "
+        "ppermute claim needs one stage per process")
+
+    model = DiffusionViT(img_size=(16, 16), patch_size=8, embed_dim=32,
+                         depth=2, num_heads=4, total_steps=10,
+                         scan_blocks=True, num_experts=2)
+    rng = np.random.RandomState(0)
+    B = 8
+    gx = rng.randn(B, 16, 16, 3).astype(np.float32)
+    gy = rng.randn(B, 16, 16, 3).astype(np.float32)
+    gt = rng.randint(1, 5, size=(B,)).astype(np.int32)
+    # the pipe axis crosses processes here, so EACH process addresses a
+    # device in every data shard — its process-local slab is the full
+    # batch (data_shard_bounds' one-shard contract applies to dp-style
+    # layouts where a process sits inside a single shard)
+    local = (gx, gy, gt)
+
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), lr=1e-3, total_steps=10,
+        sample_batch=(np.zeros((2, 16, 16, 3), np.float32),
+                      np.zeros((2, 16, 16, 3), np.float32),
+                      np.ones((2,), np.int32)))
+    state = shard_train_state(state, mesh, pipeline_param_specs(state.params))
+    step = make_train_step(
+        model, moe_aux_weight=0.01,
+        apply_fn=make_pipelined_apply(model, mesh, n_microbatch=2))
+    batch = shard_batch(local, mesh)
+    assert not batch[0].is_fully_addressable
+    state, loss, _ = step(state, batch, jax.random.PRNGKey(1),
+                          jnp.float32(5.0))
+    loss = float(loss)
+    assert np.isfinite(loss), loss
 
     with open(os.path.join(out_dir, f"loss_{proc_id}.txt"), "w") as f:
         f.write(repr(loss))

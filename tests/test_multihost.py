@@ -75,6 +75,18 @@ def test_four_process_dp_tp_sp_grouped_step(tmp_path):
     assert 0.0 < losses[0] < 10.0
 
 
+def test_two_process_pipeline_moe_step(tmp_path):
+    """GPipe ACROSS PROCESSES (round 5): {pipe:2, data:2} over 2 real DCN
+    processes puts stage 0 on process 0 and stage 1 on process 1 — every
+    schedule ppermute and the re-sown Switch aux psum cross the process
+    boundary. The pipelined apply threads the MoE aux into the step, so one
+    test pins BOTH round-5 capabilities (pipe×MoE, pipe over DCN)."""
+    losses = _spawn_workers(tmp_path, n_procs=2, local_devices=2,
+                            mode="pipemoe", timeout=600)
+    assert len(set(losses)) == 1, losses
+    assert 0.0 < losses[0] < 10.0
+
+
 def test_two_process_distributed_train_step(tmp_path):
     losses = _spawn_workers(tmp_path, n_procs=2, local_devices=4, mode="dp",
                             timeout=240)
