@@ -137,18 +137,18 @@ a200() {
 }
 run_stage apps200 "200px zero-shot apps" a200
 
-# stage 4b — the 200px FID trend that died in the stage-3 wedge, now
-# watchdog-bounded (utils/watchdog.py): a stall writes fid_trend.partial.json
-# and exits 3 instead of hanging the chain
-f200() { python scripts/fid_trend.py Saved_Models/20220822_200pxflower200_diffusion; }
-run_stage fid200 "200px fid trend" f200
-
 # stage 5a — re-validate on-chip numerics under the bf16-GEMM kernel
 # revision (ops/flash_attention.py KERNEL_REV): interpret mode proved the
 # math CPU-side; only hardware proves the Mosaic lowering computes the same
 # numbers, and this is 7 min vs the 20-min bench it gates.
 val2() { python scripts/tpu_validate.py --no-bench > results/tpu_validate_r05b.txt 2>&1; }
 run_stage validate_v2 "tpu_validate (bf16-GEMM kernel)" val2
+
+# stage 5a2 (after the numerics gate) — the 200px FID trend that died in the stage-3 wedge, now
+# watchdog-bounded (utils/watchdog.py): a stall writes fid_trend.partial.json
+# and exits 3 instead of hanging the chain
+f200() { python scripts/fid_trend.py Saved_Models/20220822_200pxflower200_diffusion; }
+run_stage fid200 "200px fid trend" f200
 
 # stage 5b — re-measure the full record under the bf16-GEMM kernel revision
 # (ops/flash_attention.py KERNEL_REV, landed mid-round after stages 0-3 had
@@ -218,7 +218,7 @@ run_stage bench_v2 "full bench (bf16-GEMM kernel)" bv2
 # on a shared host), and the chain refuses to arm a missing target.
 SELF="$REPO/scripts/recover_evidence_r05.sh"
 INCOMPLETE=0
-for s in northstar validate fullbench train200 apps200 fid200 validate_v2 bench_v2; do
+for s in northstar validate fullbench train200 apps200 validate_v2 fid200 bench_v2; do
   python scripts/r05_stage_done.py "$s" || INCOMPLETE=1
 done
 if [ "$INCOMPLETE" = 1 ] && [ "$A" -lt 5 ]; then
