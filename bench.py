@@ -257,14 +257,16 @@ def main(argv=None):
     # sections blow any sane deadline (tpu_validate --cpu runs the full
     # bench). An explicit env value always wins (tests arm it on cpu;
     # 0 disables anywhere).
-    from ddim_cold_tpu.utils.platform import effective_first_platform
+    from ddim_cold_tpu.utils.platform import watchdog_stall_s
 
-    # empty string counts as unset (a yaml/CI "unset" idiom); 1800s default:
-    # generous against legitimately slow markless windows (a big compile, one
-    # e2e epoch) while still bounding a wedge well inside driver patience
+    # shared arm-condition (utils/platform.watchdog_stall_s — also used by
+    # fid_trend/publish_run so the comma-list platform reading can't drift);
+    # 1800s default: generous against legitimately slow markless windows (a
+    # big compile, one e2e epoch) while still bounding a wedge well inside
+    # driver patience. env_stall is re-read below: an EXPLICIT env value also
+    # suppresses the auto-detected-cpu disarm after backend init.
     env_stall = os.environ.get("DDIM_COLD_BENCH_STALL_S") or None
-    stall_s = (float(env_stall) if env_stall is not None
-               else 0.0 if effective_first_platform() == "cpu" else 1800.0)
+    stall_s = watchdog_stall_s("DDIM_COLD_BENCH_STALL_S", 1800.0)
 
     def _emit_partial(label, idle):
         """Watchdog abort hook: the record (metadata + whatever sections
@@ -499,27 +501,34 @@ def main(argv=None):
             section("remat", lambda: run_layout_row("remat", remat=True))
 
         # ------------------------------------------------------------- samplers
-        def time_ddim(smodel, sparams, k, n, label):
+        def time_ddim(smodel, sparams, k, n, label, cache_interval=1,
+                      cache_mode="delta"):
             """Compile+sync one sampling run, then time TWO and keep the faster
             (one transient tunnel stall must not poison the record) — syncing via
-            a real host transfer (see time_train). Memoized per (model, k, n)."""
+            a real host transfer (see time_train). Memoized per
+            (model, k, n, cache_interval, cache_mode)."""
             from ddim_cold_tpu.ops import sampling
 
             # flax modules hash/compare by field values: same-config models
             # share a memo row across sections, and a GC'd model's reused id()
             # can never alias a different config onto a stale timing
-            key = (smodel, k, n)
+            key = (smodel, k, n, cache_interval, cache_mode)
             if key not in timed:
                 # the 200px flash kernel's first Mosaic compile is the
                 # longest silent window in the whole bench — give it slack
                 mark(f"sampler compile {label} k={k} n={n}", budget_s=2 * stall_s)
-                img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(2), k=k, n=n)
+                img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(2),
+                                           k=k, n=n, cache_interval=cache_interval,
+                                           cache_mode=cache_mode)
                 np.asarray(img)
                 best = float("inf")
                 for seed in (3, 4):
                     mark(f"sampler timing {label} k={k} n={n}")
                     t0 = time.time()
-                    img = sampling.ddim_sample(smodel, sparams, jax.random.PRNGKey(seed), k=k, n=n)
+                    img = sampling.ddim_sample(smodel, sparams,
+                                               jax.random.PRNGKey(seed), k=k, n=n,
+                                               cache_interval=cache_interval,
+                                               cache_mode=cache_mode)
                     np.asarray(img)
                     best = min(best, time.time() - t0)
                 timed[key] = best
@@ -539,20 +548,63 @@ def main(argv=None):
             section("sampler_64px", run_sampler64)
 
         def run_ksweep():
+            from ddim_cold_tpu.ops import sampling
+
             sweep = {}
+            cached = {}
             for k in (5, 20, 50) if args.smoke else (1, 5, 20, 50):
                 sweep[str(k)] = round(
                     n_sample / time_ddim(model, state.params, k, n_sample, "k-sweep"), 2)
+                if k == 1:
+                    # k=1 is ~2000 steps — a cached rerun would double the
+                    # sweep's longest leg for a row nobody tunes against
+                    continue
+                # throughput/quality trade-off per stride (ops/step_cache.py):
+                # interval=2 "full" reuse, paired same-rng pixel delta
+                sdt = time_ddim(model, state.params, k, n_sample,
+                                "k-sweep cached", cache_interval=2,
+                                cache_mode="full")
+                a = sampling.ddim_sample(model, state.params,
+                                         jax.random.PRNGKey(5), k=k, n=n_sample)
+                b = sampling.ddim_sample(model, state.params,
+                                         jax.random.PRNGKey(5), k=k, n=n_sample,
+                                         cache_interval=2, cache_mode="full")
+                cached[str(k)] = {
+                    "img_per_sec": round(n_sample / sdt, 2),
+                    "max_abs_pixel_delta": round(
+                        float(jnp.max(jnp.abs(a - b))), 6)}
             sub["ksweep_64px_img_per_sec"] = sweep
+            sub["ksweep_64px_cached_interval2_full"] = cached
 
         if args.ksweep:
             section("ksweep", run_ksweep)
 
+        # 200px north-star state, shared across run_northstar, the cached
+        # legs and run_northstar_profile: the 200px param init is one of the
+        # bench's longer silent windows and must be paid once, not re-paid
+        # per section (the profile section used to re-init its own copy)
+        ns_ctx = {"params": None, "flash_model": None}
+
+        def ns_flash_model():
+            if ns_ctx["flash_model"] is None:
+                ns_ctx["flash_model"] = DiffusionViT(
+                    dtype=jnp.bfloat16, use_flash=True,
+                    flash_blocks=NS_FLASH_BLOCKS,
+                    **MODEL_CONFIGS["oxford_flower_200_p4"])
+            return ns_ctx["flash_model"]
+
+        def ns_params_for(ns_model):
+            if ns_ctx["params"] is None:
+                mark("north-star 200px param init")
+                ns_ctx["params"] = ns_model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, 200, 200, 3)),
+                    jnp.zeros((1,), jnp.int32))["params"]
+            return ns_ctx["params"]
+
         def run_northstar():
             # the acceptance metric: 200px DDIM k=20 img/s/chip (BASELINE.json)
             n, k = 16, 20
-            ns_params = None
-            flash_model = None
             # three attention paths: dense einsum (the reference semantics),
             # the Pallas fused kernel, and the pure-XLA blockwise safety net
             # (compiles even where Mosaic rejects the kernel — Mosaic DID
@@ -561,17 +613,10 @@ def main(argv=None):
             flash_exc = None
             for impl, suffix in ((False, "_dense"), (True, "_flash"),
                                  ("xla", "_xla")):
-                ns_model = DiffusionViT(
-                    dtype=jnp.bfloat16, use_flash=impl,
-                    flash_blocks=NS_FLASH_BLOCKS if impl is True else None,
-                    **MODEL_CONFIGS["oxford_flower_200_p4"])
-                if impl is True:
-                    flash_model = ns_model
-                if ns_params is None:
-                    mark("north-star 200px param init")
-                    ns_params = ns_model.init(
-                        jax.random.PRNGKey(0),
-                        jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
+                ns_model = (ns_flash_model() if impl is True else DiffusionViT(
+                    dtype=jnp.bfloat16, use_flash=impl, flash_blocks=None,
+                    **MODEL_CONFIGS["oxford_flower_200_p4"]))
+                ns_params = ns_params_for(ns_model)
                 try:
                     sdt = time_ddim(ns_model, ns_params, k, n,
                                     f"north-star 200px {suffix[1:]}")
@@ -614,7 +659,7 @@ def main(argv=None):
             # already-captured n=16 headline as a failed section.
             n_big = 64
             try:
-                sdt = time_ddim(flash_model, ns_params, k, n_big,
+                sdt = time_ddim(ns_flash_model(), ns_params, k, n_big,
                                 f"north-star 200px flash n={n_big}")
                 sub.pop("northstar_n64_error", None)  # healed on retry
                 sub["sampler_throughput_200px_k20_flash_n64"] = {
@@ -647,20 +692,69 @@ def main(argv=None):
         if not args.skip_northstar:
             section("northstar", run_northstar)
 
+        def run_northstar_cached():
+            # the tentpole leg: step-cached 200px sampling (ops/step_cache.py).
+            # "full" reuse at interval=2 skips the whole transformer trunk on
+            # every odd step (the ≥1.5× headline config); "delta" is the
+            # Δ-DiT-style half-trunk variant recorded alongside for the
+            # quality-first trade-off. Both carry a paired same-rng
+            # max-abs-pixel-delta guard against the exact flash sampler.
+            from ddim_cold_tpu.ops import sampling
+
+            n, k, interval = 16, 20, 2
+            cm = ns_flash_model()
+            cp = ns_params_for(cm)
+            # memoized — free when the northstar section already ran
+            exact_t = time_ddim(cm, cp, k, n, "north-star 200px flash")
+            img_exact = np.asarray(sampling.ddim_sample(
+                cm, cp, jax.random.PRNGKey(5), k=k, n=n))
+            for mode, name in (("full", "sampler_throughput_200px_k20_cached"),
+                               ("delta",
+                                "sampler_throughput_200px_k20_cached_delta")):
+                sdt = time_ddim(cm, cp, k, n, f"north-star cached {mode}",
+                                cache_interval=interval, cache_mode=mode)
+                img_c = np.asarray(sampling.ddim_sample(
+                    cm, cp, jax.random.PRNGKey(5), k=k, n=n,
+                    cache_interval=interval, cache_mode=mode))
+                sub[name] = {
+                    "value": round(n / sdt, 2), "unit": "img/s/chip",
+                    "n": n, "k": k, "cache_interval": interval,
+                    "cache_mode": mode,
+                    "speedup_vs_exact_flash": round(exact_t / sdt, 3),
+                    "max_abs_pixel_delta": round(
+                        float(np.max(np.abs(img_c - img_exact))), 6)}
+
+        if not args.skip_northstar:
+            section("northstar_cached", run_northstar_cached)
+
+        def run_cached_quality():
+            # distributional guard for the step cache at 64px (chip-cheap;
+            # the 200px legs above carry the pixel-delta guard): Fréchet
+            # distance between exact and cached sample streams from the SAME
+            # rng under one extractor — 0 when the cache is harmless, and the
+            # acceptance bound ("FID shift ≤ 0.5") reads directly off it
+            from ddim_cold_tpu.eval import fid as fid_mod
+
+            n_q = 32 if args.smoke else 256
+            sub["cached_quality_64px"] = fid_mod.cached_sampler_guard(
+                model, state.params, rng=jax.random.PRNGKey(17),
+                n_samples=n_q, sample_batch=min(n_q, 64), k=20,
+                cache_interval=2, cache_mode="full")
+            log(f"cached quality 64px: {sub['cached_quality_64px']}")
+
+        if not args.skip_sampler:
+            section("cached_quality", run_cached_quality, retries=0)
+
         def run_northstar_profile():
             # one traced tuned-blocks flash sampling run (n=16, k=20): the
             # timeline that says where the remaining sampler time goes. The
-            # model/params/compile are memoized from the northstar section;
-            # the trace adds one extra timed-path execution of chip time.
+            # model/params/compile are shared with the northstar sections
+            # via ns_ctx — no second 200px param init; the trace adds one
+            # extra timed-path execution of chip time.
             from ddim_cold_tpu.ops import sampling
 
-            prof_model = DiffusionViT(
-                dtype=jnp.bfloat16, use_flash=True,
-                flash_blocks=NS_FLASH_BLOCKS,
-                **MODEL_CONFIGS["oxford_flower_200_p4"])
-            prof_params = prof_model.init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((1, 200, 200, 3)), jnp.zeros((1,), jnp.int32))["params"]
+            prof_model = ns_flash_model()
+            prof_params = ns_params_for(prof_model)
             # warm the compile outside the trace window
             np.asarray(sampling.ddim_sample(
                 prof_model, prof_params, jax.random.PRNGKey(2), k=20, n=16))

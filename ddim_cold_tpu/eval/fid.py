@@ -174,12 +174,16 @@ def compute_fid(
     inception_model=None,
     inception_variables=None,
     sampler: Optional[Callable] = None,
+    cache_interval: int = 1,
+    cache_mode: str = "delta",
 ) -> float:
     """FID of a diffusion model's samples against a real-image stream.
 
     ``model/params`` are the DiffusionViT; samples are drawn with
     ``ops.sampling.ddim_sample`` at stride ``k`` (the north-star metric path:
     200px, k=20) unless a custom ``sampler(rng, n) → [0,1] images`` is given.
+    ``cache_interval``/``cache_mode`` pass through to the sampler's step
+    cache (ops/step_cache.py); the default interval=1 is the exact sampler.
     """
     from ddim_cold_tpu.ops import sampling
 
@@ -194,7 +198,70 @@ def compute_fid(
         keep = min(sample_batch, remaining)
         rng, sub = jax.random.split(rng)
         imgs = (sampler(sub, sample_batch) if sampler is not None
-                else sampling.ddim_sample(model, params, sub, k=k, n=sample_batch))
+                else sampling.ddim_sample(model, params, sub, k=k, n=sample_batch,
+                                          cache_interval=cache_interval,
+                                          cache_mode=cache_mode))
         fake.update(np.asarray(feature_fn(imgs))[:keep])
         remaining -= keep
     return fid_from_stats(real, fake)
+
+
+def cached_sampler_guard(
+    model,
+    params,
+    *,
+    rng: jax.Array,
+    n_samples: int = 256,
+    sample_batch: int = 64,
+    k: int = 20,
+    cache_interval: int = 2,
+    cache_mode: str = "full",
+    inception_model=None,
+    inception_variables=None,
+) -> dict:
+    """Quality guard for the step-cached sampler (ops/step_cache.py): the
+    Fréchet distance between the EXACT and CACHED samplers' output streams
+    drawn from the SAME rng sequence, under one extractor.
+
+    This is deliberately not "FID vs the real set twice": a paired
+    exact-vs-cached distance isolates the cache's own distributional shift
+    (it is exactly 0 when the cache is harmless and needs no real images or
+    canonical extractor weights), where two FID-vs-real numbers would bury a
+    small shift under the shared real-set term. With no
+    ``inception_variables`` the extractor is the seeded random-init proxy
+    (see :func:`make_feature_fn`) — fine here, because both streams go
+    through the SAME extractor and only their distance is reported.
+
+    Returns a dict with ``fid_exact_vs_cached``, ``max_abs_pixel_delta``
+    (worst per-pixel divergence across every paired batch) and the sampler
+    configuration, ready to land in a bench record.
+    """
+    from ddim_cold_tpu.ops import sampling
+
+    feature_fn, dim = make_feature_fn(inception_model, inception_variables)
+    exact, cached = ActivationStats(dim), ActivationStats(dim)
+    max_delta = 0.0
+    remaining = n_samples
+    while remaining > 0:
+        keep = min(sample_batch, remaining)
+        rng, sub = jax.random.split(rng)
+        imgs_e = sampling.ddim_sample(model, params, sub, k=k, n=sample_batch)
+        imgs_c = sampling.ddim_sample(model, params, sub, k=k, n=sample_batch,
+                                      cache_interval=cache_interval,
+                                      cache_mode=cache_mode)
+        max_delta = max(max_delta, float(jnp.max(jnp.abs(imgs_e - imgs_c))))
+        exact.update(np.asarray(feature_fn(imgs_e))[:keep])
+        cached.update(np.asarray(feature_fn(imgs_c))[:keep])
+        remaining -= keep
+    return {
+        "fid_exact_vs_cached": round(float(fid_from_stats(exact, cached)), 4),
+        "max_abs_pixel_delta": round(max_delta, 6),
+        "n_samples": n_samples,
+        "k": k,
+        "cache_interval": cache_interval,
+        "cache_mode": cache_mode,
+        "extractor": ("canonical" if inception_variables is not None else
+                      "seeded random-init proxy (paired streams, same "
+                      "extractor — distance is meaningful, absolute FID "
+                      "scale is not)"),
+    }

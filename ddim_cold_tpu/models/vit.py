@@ -526,12 +526,61 @@ class DiffusionViT(nn.Module):
         return_attention_layer: Optional[int] = None,
         stage: str = "full",
         tokens: Optional[jax.Array] = None,
+        skip_blocks: Optional[tuple] = None,
+        block_delta: Optional[jax.Array] = None,
+        capture_split: Optional[int] = None,
     ) -> jax.Array:
         """``stage`` partitions the forward for pipeline parallelism
         (parallel/pipeline.py): ``"embed"`` returns the token sequence after
         patch/pos/time embedding; ``"head"`` takes ``tokens`` (the trunk
         output, supplied by the pipeline) and runs final-LN → head →
-        un-patchify; ``"full"`` is the normal forward."""
+        un-patchify; ``"full"`` is the normal forward.
+
+        Step-cache hooks (ops/step_cache.py, Δ-DiT-style training-free
+        sampler acceleration):
+
+        * ``capture_split=s`` (static, 1 ≤ s < depth) — a *refresh* forward:
+          run every block and additionally return the cumulative residual
+          deltas of the front (blocks [0, s)) and rear (blocks [s, depth))
+          trunk halves, ``(x̂0, (delta_front, delta_rear))``. Each delta is
+          the (B, N+1, E) token-stream displacement the half contributes;
+          because blocks are residual, the sum over a contiguous range is
+          exactly ``tokens_out − tokens_in`` of that range.
+        * ``skip_blocks=(lo, hi)`` + ``block_delta`` (static range, traced
+          delta) — a *reuse* forward: blocks [lo, hi) are never executed;
+          their cached cumulative delta is added to the token stream where
+          block ``lo`` would have run. The skipped blocks' parameters are
+          untouched (flax ``apply`` tolerates unused params), so reuse steps
+          pay only the remaining blocks' FLOPs.
+
+        Both are static trace-time decisions — no device branching — and are
+        mutually exclusive with each other, with ``scan_blocks`` (one scanned
+        body cannot statically drop layers), with the attention probe, and
+        with partial ``stage`` forwards."""
+        if skip_blocks is not None or capture_split is not None:
+            if self.scan_blocks:
+                raise ValueError(
+                    "step caching (skip_blocks/capture_split) requires "
+                    "scan_blocks=False — one scanned block body cannot "
+                    "statically drop layers")
+            if stage != "full":
+                raise ValueError("step caching composes with stage='full' only")
+            if return_attention_layer is not None:
+                raise ValueError("step caching excludes the attention probe")
+        if skip_blocks is not None and capture_split is not None:
+            raise ValueError(
+                "skip_blocks (reuse step) and capture_split (refresh step) "
+                "are distinct cache branches — pass one or the other")
+        if skip_blocks is not None:
+            lo, hi = skip_blocks
+            if not (0 <= lo < hi <= self.depth):
+                raise ValueError(f"skip_blocks {skip_blocks} outside "
+                                 f"[0, {self.depth})")
+            if block_delta is None:
+                raise ValueError("skip_blocks requires the cached block_delta")
+        if capture_split is not None and not (1 <= capture_split < self.depth):
+            raise ValueError(f"capture_split {capture_split} must split "
+                             f"depth {self.depth} into two non-empty halves")
         B = x.shape[0]
         E = self.embed_dim
         N = self.num_patches
@@ -624,7 +673,14 @@ class DiffusionViT(nn.Module):
             # deterministic (argnum 2; 0 is the module) is a Python bool
             # steering trace-time structure — static under jax.checkpoint.
             block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+            lo, hi = skip_blocks if skip_blocks is not None else (0, 0)
+            tokens_in = tokens if capture_split is not None else None
+            tokens_mid = None
             for i in range(self.depth):
+                if skip_blocks is not None and lo <= i < hi:
+                    if i == lo:
+                        tokens = tokens + block_delta.astype(self.dtype)
+                    continue
                 blk_kwargs = dict(
                     dim=E,
                     num_heads=self.num_heads,
@@ -657,7 +713,10 @@ class DiffusionViT(nn.Module):
                 # positional deterministic: jax.checkpoint static_argnums
                 # covers positionals only; Dropout branches on it in Python.
                 tokens = block_cls(**blk_kwargs, name=f"blocks_{i}")(tokens, deterministic)
+                if capture_split is not None and i == capture_split - 1:
+                    tokens_mid = tokens
 
+        trunk_out = tokens  # pre-norm trunk output — the delta reference point
         tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
         tokens = nn.Dense(
             self.in_chans * self.patch_size**2,
@@ -666,7 +725,10 @@ class DiffusionViT(nn.Module):
             bias_init=nn.initializers.zeros_init(),
             name="head",
         )(tokens)
-        return self.unpatchify(tokens[:, 1:, :]).astype(jnp.float32)
+        out = self.unpatchify(tokens[:, 1:, :]).astype(jnp.float32)
+        if capture_split is not None:
+            return out, (tokens_mid - tokens_in, trunk_out - tokens_mid)
+        return out
 
     def unpatchify(self, x: jax.Array) -> jax.Array:
         """(B, N, p²C) → (B, H, W, C), exact reference pixel mapping.

@@ -1,3 +1,3 @@
-from ddim_cold_tpu.ops import schedule
+from ddim_cold_tpu.ops import schedule, step_cache
 
-__all__ = ["schedule"]
+__all__ = ["schedule", "step_cache"]

@@ -41,6 +41,10 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
 
+#: Pallas-TPU compiler params across jax versions (renamed from
+#: TPUCompilerParams to CompilerParams; same fields we use)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 #: kernel revision stamped into bench records (scripts/r05_stage_done.py keys
 #: re-measurement off it): "bf16-gemm-v2" = GEMMs in input dtype with f32 MXU
 #: accumulation (the r05 change); the original always-f32-GEMM kernel — the
@@ -108,7 +112,10 @@ def _sds(shape, dtype, like: jax.Array) -> jax.ShapeDtypeStruct:
     """ShapeDtypeStruct carrying ``like``'s varying-manual-axes type — needed
     when the kernel runs inside a ``shard_map`` (e.g. as Ulysses' local
     attention) where ``check_vma`` requires outputs to declare their vma."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    # jax.typeof (and vma-typed avals) only exist on newer jax; without them
+    # there is no vma checker to satisfy, so the plain struct is correct
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -196,7 +203,7 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
             pltpu.VMEM((bq, _LANE), jnp.float32),  # running max (lane-replicated)
             pltpu.VMEM((bq, _LANE), jnp.float32),  # running denominator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=jax.default_backend() == "cpu",
@@ -326,7 +333,7 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
         out_specs=q_spec,
         out_shape=_sds(qh.shape, q.dtype, qh),
         scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qh, kh, vh, gh, lse, delta)
@@ -346,7 +353,7 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
                    _sds(vh.shape, v.dtype, vh)],
         scratch_shapes=[pltpu.VMEM((bkv, Dp), jnp.float32),
                         pltpu.VMEM((bkv, Dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qh, kh, vh, gh, lse, delta)

@@ -31,7 +31,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ddim_cold_tpu.ops import schedule
+from ddim_cold_tpu.ops import schedule, step_cache
 
 
 def forward_noise(rng: jax.Array, img: jax.Array, t_start: int, total_steps: int = 2000):
@@ -103,6 +103,50 @@ def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
     return (x0_last + 1.0) / 2.0
 
 
+@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta",
+                                   "cache_interval", "cache_mode", "sequence"))
+def _ddim_scan_cached(model, params, x_init, noise_rng, cache0, *, k: int,
+                      t_start: Optional[int], eta: float,
+                      cache_interval: int, cache_mode: str, sequence: bool):
+    """The feature-cached DDIM scan (ops/step_cache.py): same affine update
+    as the plain scans, but the model evaluation routes through a
+    ``lax.switch`` over the static refresh/reuse schedule and the block-delta
+    cache rides the carry. One variant serves both the last-only and
+    sequence-returning paths (``sequence`` is static) so the cached and exact
+    samplers can never drift onto different update algebra."""
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
+    spec = step_cache.cache_spec(model.depth, len(coeffs.t_seq),
+                                 cache_interval, cache_mode)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, x0_prev, cache = carry
+        (t, c1, c2, cz), br = inputs
+        x0_raw, cache = step_cache.apply_step(
+            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        x0 = jnp.clip(x0_raw, -1.0, 1.0)
+        x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
+        return (x_next, x0, cache), (x0 if sequence else None)
+
+    carry0 = (x_init, jnp.zeros_like(x_init), cache0)
+    branches = jnp.asarray(spec.branches, jnp.int32)
+    (_, x0_last, _), x0_out = jax.lax.scan(
+        step, carry0, (_scan_inputs(coeffs), branches))
+    if sequence:
+        frames = jnp.concatenate([x_init[None], x0_out], axis=0)
+        return (frames + 1.0) / 2.0
+    return (x0_last + 1.0) / 2.0
+
+
+def _make_cache(model, x_init: jax.Array, mesh) -> step_cache.Cache:
+    """Build the zero cache carry host-side and, under SPMD sampling, place
+    it batch-sharded over the mesh's 'data' axis alongside the sample batch
+    — explicit placement, so the scan's cache shards never gather."""
+    cache = step_cache.init_cache(x_init.shape[0], model.num_patches + 1,
+                                  model.embed_dim, model.dtype)
+    return step_cache.shard_cache(cache, mesh)
+
+
 def _shard_init(x_init: jax.Array, mesh) -> jax.Array:
     """Place the sample batch sharded over the mesh's 'data' axis: the whole
     scan then runs SPMD (params replicated, one psum-free forward per shard)
@@ -127,6 +171,8 @@ def ddim_sample(
     return_sequence: bool = False,
     mesh=None,
     eta: float = 0.0,
+    cache_interval: int = 1,
+    cache_mode: str = "delta",
 ) -> jax.Array:
     """k-strided DDIM sampling; returns images in [0, 1], NHWC.
 
@@ -143,6 +189,15 @@ def ddim_sample(
     paper (schedule.ddim_coefficients; beyond-parity, default 0 = the
     reference's deterministic path, bit-exact). ``eta`` > 0 draws per-step
     noise from ``rng``, which is then required even with ``x_init``.
+
+    ``cache_interval`` > 1 turns on training-free feature caching
+    (ops/step_cache.py): every ``cache_interval``-th step runs the full model
+    and refreshes a block-delta cache; the steps between skip the
+    ``cache_mode``-selected trunk blocks ("delta" = the Δ-DiT front/rear
+    phase split, "full" = the whole trunk) and apply the cached deltas
+    instead. The schedule is static, so the scan stays one compiled program
+    per (k, interval, mode). ``cache_interval=1`` (default) takes the plain
+    scan — bit-for-bit the exact sampler. Requires ``scan_blocks=False``.
     """
     if eta and rng is None:
         raise ValueError("eta > 0 draws per-step noise — pass rng")
@@ -156,6 +211,11 @@ def ddim_sample(
     # per-step noise must not be correlated with it
     noise_rng = (jax.random.fold_in(rng, 0xD1F) if rng is not None
                  else jax.random.PRNGKey(0))
+    if step_cache.enabled(cache_interval):
+        return _ddim_scan_cached(
+            model, params, x_init, noise_rng, _make_cache(model, x_init, mesh),
+            k=k, t_start=t_start, eta=eta, cache_interval=cache_interval,
+            cache_mode=cache_mode, sequence=return_sequence)
     if return_sequence:
         return _ddim_scan_sequence(model, params, x_init, noise_rng,
                                    k=k, t_start=t_start, eta=eta)
@@ -165,16 +225,21 @@ def ddim_sample(
 
 def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
                 eta: float = 0.0,
-                rng: Optional[jax.Array] = None) -> jax.Array:
+                rng: Optional[jax.Array] = None,
+                cache_interval: int = 1,
+                cache_mode: str = "delta") -> jax.Array:
     """Guided sampling: DDIM-denoise an encoded image from level ``t_start``.
 
     Strictly a prefix-truncated ``ddim_sample`` (SURVEY.md C24). The
     draft2drawing app composes this with ``forward_noise``; slerp interpolation
     (C25) composes it with a spherical mix of two encodings. ``eta`` > 0
     switches to stochastic DDIM (see ``ddim_sample``) and requires ``rng``.
+    ``cache_interval``/``cache_mode`` thread through to the feature-cached
+    sampler (see ``ddim_sample``).
     """
     return ddim_sample(model, params, rng, x_init=x_init, t_start=t_start,
-                       k=k, eta=eta)
+                       k=k, eta=eta, cache_interval=cache_interval,
+                       cache_mode=cache_mode)
 
 
 def slerp(a: jax.Array, b: jax.Array, frac: jax.Array) -> jax.Array:
@@ -250,6 +315,33 @@ def _cold_scan(model, params, x_init, *, levels: int, return_sequence: bool):
     return (x_last + 1.0) / 2.0
 
 
+@partial(jax.jit, static_argnames=("model", "levels", "return_sequence",
+                                   "cache_interval", "cache_mode"))
+def _cold_scan_cached(model, params, x_init, cache0, *, levels: int,
+                      return_sequence: bool, cache_interval: int,
+                      cache_mode: str):
+    """Feature-cached cold-diffusion scan — same naive Algorithm-1 update as
+    ``_cold_scan``, model evaluation routed through the step cache."""
+    t_seq = jnp.asarray(schedule.cold_time_sequence(levels))
+    spec = step_cache.cache_spec(model.depth, levels, cache_interval, cache_mode)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, cache = carry
+        t, br = inputs
+        x0_raw, cache = step_cache.apply_step(
+            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        x0 = jnp.clip(x0_raw, -1.0, 1.0)
+        return (x0, cache), (x0 if return_sequence else None)
+
+    branches = jnp.asarray(spec.branches, jnp.int32)
+    (x_last, _), frames = jax.lax.scan(step, (x_init, cache0),
+                                       (t_seq, branches))
+    if return_sequence:
+        return (jnp.concatenate([x_init[None], frames], axis=0) + 1.0) / 2.0
+    return (x_last + 1.0) / 2.0
+
+
 def cold_sample(
     model,
     params,
@@ -259,6 +351,8 @@ def cold_sample(
     levels: int = 6,
     return_sequence: bool = False,
     mesh=None,
+    cache_interval: int = 1,
+    cache_mode: str = "delta",
 ) -> jax.Array:
     """Cold-diffusion sampling from per-sample constant-color "noise".
 
@@ -266,9 +360,16 @@ def cold_sample(
     (reference ViT_draft2drawing.py:264 — the fully-downsampled degenerate
     state); ``levels`` defaults to 6 = log2(64). With a ``mesh``, the batch
     runs SPMD sharded over its 'data' axis (see ``ddim_sample``).
+    ``cache_interval`` > 1 enables the feature-cached scan (see
+    ``ddim_sample``); 1 is bit-for-bit the plain sampler.
     """
     H, W = model.img_size
     color = jax.random.normal(rng, (n, 1, 1, model.in_chans), jnp.float32)
     x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
     x_init = _shard_init(x_init, mesh)
+    if step_cache.enabled(cache_interval):
+        return _cold_scan_cached(
+            model, params, x_init, _make_cache(model, x_init, mesh),
+            levels=levels, return_sequence=return_sequence,
+            cache_interval=cache_interval, cache_mode=cache_mode)
     return _cold_scan(model, params, x_init, levels=levels, return_sequence=return_sequence)

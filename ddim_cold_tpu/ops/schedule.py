@@ -130,3 +130,51 @@ def ddim_coefficients(total_steps: int, k: int, t_start: int | None = None,
 def cold_time_sequence(levels: int = 6) -> np.ndarray:
     """Cold-diffusion visit order t = levels..1 (reference ViT_draft2drawing.py:271)."""
     return np.arange(levels, 0, -1, dtype=np.int32)
+
+
+#: step-cache branch ids (ops/step_cache.py): the scan feeds one of these per
+#: reverse step, precomputed host-side like the DDIM coefficients above — the
+#: refresh/reuse pattern is STATIC, so XLA compiles one program per
+#: (k, interval, mode) with both branch bodies and no host sync.
+CACHE_REFRESH = 0  # full forward, (re)populate the block-delta cache
+CACHE_REUSE_REAR = 1  # skip the REAR trunk half, apply its cached delta
+CACHE_REUSE_FRONT = 2  # skip the FRONT trunk half, apply its cached delta
+CACHE_REUSE_ALL = 1  # ("full" mode) skip the whole trunk, apply both deltas
+
+
+def cache_branch_sequence(n_steps: int, cache_interval: int,
+                          cache_mode: str = "delta") -> np.ndarray:
+    """Per-step refresh/reuse branch ids for the feature-cached samplers.
+
+    Uniform stride: step i refreshes iff ``i % cache_interval == 0`` (step 0
+    always refreshes — the cache starts empty), every other step reuses.
+    ``cache_mode`` picks what a reuse step skips:
+
+    * ``"delta"`` — Δ-DiT-style front/rear split (arXiv:2406.01125): reverse
+      diffusion lays down image structure in the EARLY (high-noise) steps and
+      detail in the LATE steps, and the two live in different trunk halves —
+      so early-phase reuse steps skip the rear half (CACHE_REUSE_REAR) and
+      late-phase reuse steps skip the front half (CACHE_REUSE_FRONT). Skips
+      half the block FLOPs per reuse step.
+    * ``"full"`` — reuse steps skip the whole trunk (CACHE_REUSE_ALL): only
+      the embed/head run against the fresh (x_t, t). Skips all block FLOPs
+      per reuse step; the cheaper/looser end of the trade-off.
+
+    ``cache_interval <= 1`` returns all-refresh (caching disabled; the
+    samplers bypass the cache machinery entirely for bit-exactness with the
+    plain scan).
+    """
+    if cache_mode not in ("delta", "full"):
+        raise ValueError(f"cache_mode must be 'delta' or 'full', got {cache_mode!r}")
+    branch = np.zeros(n_steps, dtype=np.int32)
+    if cache_interval <= 1:
+        return branch
+    idx = np.arange(n_steps)
+    reuse = (idx % cache_interval) != 0
+    if cache_mode == "full":
+        branch[reuse] = CACHE_REUSE_ALL
+    else:
+        early = idx < (n_steps + 1) // 2
+        branch[reuse & early] = CACHE_REUSE_REAR
+        branch[reuse & ~early] = CACHE_REUSE_FRONT
+    return branch

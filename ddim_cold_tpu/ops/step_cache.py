@@ -1,0 +1,166 @@
+"""Training-free DDIM step caching — reuse transformer block deltas across
+adjacent sampler steps (Δ-DiT, arXiv:2406.01125).
+
+Adjacent reverse-diffusion steps feed the ViT nearly identical activations, so
+the token-stream displacement a contiguous run of residual blocks contributes
+(``tokens_out − tokens_in``, the *cumulative block delta*) barely moves between
+steps. This module caches those deltas on periodic *refresh* steps and, on the
+*reuse* steps in between, replaces the skipped blocks with one add of the
+cached delta — no retraining, no extra parameters, and (empirically, Δ-DiT)
+nearly FID-neutral at small intervals.
+
+Design constraints inherited from ops/sampling.py:19-22 — the samplers are
+single jitted ``lax.scan`` loops with no host↔device traffic until the final
+gather. The refresh/reuse pattern is therefore a STATIC host-side schedule
+(ops/schedule.py:cache_branch_sequence, generated next to the DDIM
+coefficients): the scan body is one ``lax.switch`` over per-step branch ids
+fed as a scanned input, XLA compiles every branch body into the one program,
+and the cache pytree rides the scan carry. With a mesh, the cache is placed
+batch-sharded over the 'data' axis exactly like the sample batch, so SPMD
+sampling stays psum-free.
+
+What a branch does (model hooks: models/vit.py ``capture_split`` /
+``skip_blocks``):
+
+* refresh   — full forward; emit ``(delta_front, delta_rear)``, the cumulative
+              deltas of the trunk halves split at ``spec.split``.
+* reuse     — "delta" mode: skip the phase-appropriate half (rear in the early
+              sampling phase, front in the late phase) and add its cached
+              delta; "full" mode: skip the whole trunk, add both.
+
+Cost model: a reuse step skips ``(hi−lo)/depth`` of the block FLOPs (embed,
+head, and the un-skipped blocks still run). See :func:`flops_saved_fraction`
+and the PERF.md "Cached sampler" section for the measured numbers.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ddim_cold_tpu.ops import schedule
+
+#: cache pytree: (delta_front, delta_rear), each (B, N+1, E) in the model's
+#: compute dtype. Kept as a flat tuple so the scan carry stays a plain pytree.
+Cache = tuple
+
+
+class CacheSpec(NamedTuple):
+    """Static description of one cached-sampling run — hashable, so jitted
+    samplers can close over it keyed by their (k, interval, mode) statics."""
+
+    depth: int  # model trunk depth
+    split: int  # front half = blocks [0, split), rear = [split, depth)
+    mode: str  # "delta" | "full"
+    interval: int  # refresh stride (1 = caching disabled)
+    branches: tuple  # per-step branch ids (static schedule)
+
+    @property
+    def n_steps(self) -> int:
+        return len(self.branches)
+
+
+def enabled(cache_interval: Optional[int]) -> bool:
+    """True when the interval actually turns caching on. ``<= 1`` means every
+    step refreshes, i.e. the exact sampler — callers bypass the cache
+    machinery entirely so interval=1 stays bit-for-bit the plain scan."""
+    return cache_interval is not None and cache_interval > 1
+
+
+def cache_spec(depth: int, n_steps: int, cache_interval: int,
+               cache_mode: str = "delta",
+               split: Optional[int] = None) -> CacheSpec:
+    """Build the static spec for a run of ``n_steps`` reverse steps.
+
+    ``split`` defaults to ``depth // 2`` — the Δ-DiT front/rear halving. The
+    model must have ≥ 2 blocks (a 1-block trunk has no half to skip).
+    """
+    if depth < 2:
+        raise ValueError(f"step caching needs depth >= 2 blocks, got {depth}")
+    if split is None:
+        split = depth // 2
+    if not (1 <= split < depth):
+        raise ValueError(f"split {split} must lie in [1, {depth})")
+    branches = schedule.cache_branch_sequence(n_steps, cache_interval, cache_mode)
+    return CacheSpec(depth=depth, split=int(split), mode=cache_mode,
+                     interval=int(cache_interval),
+                     branches=tuple(int(b) for b in branches))
+
+
+def init_cache(n: int, n_tokens: int, embed_dim: int, dtype) -> Cache:
+    """Zero-filled cache carry. The schedule's step 0 is always a refresh, so
+    the zeros are never consumed — they only fix the carry's shape/dtype."""
+    z = jnp.zeros((n, n_tokens, embed_dim), dtype)
+    return (z, z)
+
+
+def shard_cache(cache: Cache, mesh) -> Cache:
+    """Place the cache batch-sharded over the mesh's 'data' axis — the same
+    placement as the sample batch (sampling._shard_init), so the SPMD scan
+    carries one cache shard per chip and never gathers activations."""
+    if mesh is None:
+        return cache
+    from ddim_cold_tpu.parallel.mesh import batch_sharding
+
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), cache)
+
+
+def apply_step(model, params, x: jax.Array, t_vec: jax.Array,
+               branch: jax.Array, cache: Cache, spec: CacheSpec):
+    """One cache-aware model evaluation inside the sampler scan body.
+
+    ``branch`` is the step's traced branch id (scanned input from
+    ``spec.branches``); returns ``(x0_raw, new_cache)``. Every branch returns
+    the same pytree structure, so ``lax.switch`` compiles all of them into
+    the one scan program — the refresh/reuse decision costs no host sync.
+    """
+    depth, split = spec.depth, spec.split
+
+    def refresh(x, cache):
+        x0, deltas = model.apply({"params": params}, x, t_vec,
+                                 capture_split=split)
+        return x0, deltas
+
+    def reuse_rear(x, cache):
+        x0 = model.apply({"params": params}, x, t_vec,
+                         skip_blocks=(split, depth), block_delta=cache[1])
+        return x0, cache
+
+    def reuse_front(x, cache):
+        x0 = model.apply({"params": params}, x, t_vec,
+                         skip_blocks=(0, split), block_delta=cache[0])
+        return x0, cache
+
+    def reuse_all(x, cache):
+        x0 = model.apply({"params": params}, x, t_vec,
+                         skip_blocks=(0, depth),
+                         block_delta=cache[0] + cache[1])
+        return x0, cache
+
+    if spec.mode == "full":
+        branches = (refresh, reuse_all)
+    else:
+        branches = (refresh, reuse_rear, reuse_front)
+    return jax.lax.switch(branch, branches, x, cache)
+
+
+def flops_saved_fraction(spec: CacheSpec) -> float:
+    """Fraction of the run's BLOCK compute the schedule skips (embed/head and
+    the schedule itself excluded) — the analytic ceiling on the speedup's
+    compute term, quoted next to measured numbers in bench/PERF.md."""
+    if not spec.branches:
+        return 0.0
+    saved = 0.0
+    for b in spec.branches:
+        if b == schedule.CACHE_REFRESH:
+            continue
+        if spec.mode == "full":
+            saved += 1.0  # the whole trunk skipped
+        elif b == schedule.CACHE_REUSE_REAR:
+            saved += (spec.depth - spec.split) / spec.depth
+        else:  # CACHE_REUSE_FRONT
+            saved += spec.split / spec.depth
+    return saved / len(spec.branches)

@@ -1,0 +1,51 @@
+"""shard_map across jax versions.
+
+The parallel subsystem is written against the current top-level API
+(``jax.shard_map`` with ``axis_names`` naming the MANUAL axes and
+``check_vma`` for the varying-axes checker). Older jax (< 0.6) ships
+shard_map under ``jax.experimental`` with the inverse/older spellings:
+``auto`` names the axes that STAY automatic, and the checker flag is
+``check_rep``. This shim presents the new surface on both.
+
+Translation rules on the legacy path:
+* ``axis_names`` given → ``auto = mesh axes − axis_names``; omitted →
+  fully manual (``auto = ∅``), matching the new default.
+* ``check_vma`` maps to ``check_rep`` — except that legacy partial-auto
+  shard_map rejects ``check_rep=True``, so a nonempty ``auto`` forces it
+  off (the caller's checker request is best-effort there, not a semantics
+  change: the checker only verifies replication annotations).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax ≥ 0.6: the public top-level export — use it verbatim
+    from jax import shard_map  # noqa: F401
+except ImportError:  # jax 0.4/0.5
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=True):
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        else:
+            auto = frozenset()
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            auto=auto, check_rep=bool(check_vma) and not auto)
+
+
+def pcast(x, axes, *, to):
+    """``jax.lax.pcast`` when the running jax has it; identity otherwise.
+
+    New-jax shard_map types every array with the manual axes it varies over
+    and ``pcast(..., to="varying")`` is how a replicated literal (e.g. a zeros
+    accumulator) is promoted to match a varying loop carry. Legacy shard_map
+    has no varying-axes type system — every array is just an array — so there
+    is nothing to promote and identity is the faithful translation.
+    """
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)

@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ddim_cold_tpu.parallel import _compat
+from ddim_cold_tpu.parallel._compat import shard_map
 
 
 def pipeline_blocks(
@@ -78,11 +79,19 @@ def pipeline_blocks(
 
     ``with_aux`` (pipe×MoE): returns ``(tokens, aux)`` where ``aux`` is the
     mean of every sown 'losses' scalar across (layer, microbatch, seq shard)
-    — the pipeline equivalent of the plain path's layer-stacked ``moe_aux``
-    (train/step.py normalizes by element count, so the pre-normalized mean
-    slots in unchanged). Bubble-step applications are masked out: their
-    tokens are garbage and their router stats would bias the load-balance
-    term. Per data shard, shape (1,), P(batch_axis) — callers mean over it.
+    — the pipeline COUNTERPART of the plain path's layer-stacked ``moe_aux``,
+    not a numerical reproduction of it. Each router here sees one microbatch
+    (B/M tokens), so the load-balance term is a mean of per-microbatch
+    statistics; the unpipelined path's router sees the full batch, and a
+    load-balance penalty is nonlinear in the router's batch (fraction-routed
+    × mean-gate products do not average across splits). Same standard GPipe
+    + MoE semantics as e.g. GShard — equal in expectation, bit-different in
+    value, and gradients steer routing per-microbatch, which is what a
+    pipelined deployment actually load-balances. (train/step.py normalizes
+    by element count, so the pre-normalized mean slots in unchanged.)
+    Bubble-step applications are masked out: their tokens are garbage and
+    their router stats would bias the load-balance term. Per data shard,
+    shape (1,), P(batch_axis) — callers mean over it.
     """
     n_stages = int(mesh.shape[axis])
     depth = int(jax.tree.leaves(stacked_params)[0].shape[0])
@@ -194,7 +203,7 @@ def pipeline_blocks(
                 tok, a = apply_block(p, tok, rate, rngs)
                 return (tok, aux + a), None
 
-            aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), aux_axes,
+            aux0 = _compat.pcast(jnp.zeros((), jnp.float32), aux_axes,
                                  to="varying")
             (tok, aux), _ = jax.lax.scan(
                 body, (tok, aux0), (params_s, dpr_s, jnp.arange(bps)))
@@ -204,10 +213,10 @@ def pipeline_blocks(
         # accumulators must be typed varying over the pipe axis too (values
         # differ per stage via params/ppermute) for shard_map's vma loop
         # typing; zeros_like already inherits the data-varying from mb_all
-        vary = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+        vary = lambda z: _compat.pcast(z, (axis,), to="varying")
         out_buf = vary(jnp.zeros_like(mb_all))
         buf = vary(jnp.zeros_like(mb_all[0]))
-        aux_acc = jax.lax.pcast(jnp.zeros((), jnp.float32), aux_axes,
+        aux_acc = _compat.pcast(jnp.zeros((), jnp.float32), aux_axes,
                                 to="varying")
 
         def step(carry, i):

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ddim_cold_tpu.parallel._compat import shard_map
 
 
 def ulysses_attention(

@@ -134,6 +134,26 @@ def effective_first_platform() -> str:
     return effective_platforms().split(",")[0].strip()
 
 
+def watchdog_stall_s(env_var: str, accel_default_s: float) -> float:
+    """The shared watchdog arm-condition: how long a device-touching script
+    may go silent before its StallWatchdog aborts it.
+
+    An explicit env value always wins (``0`` disarms; empty string counts as
+    unset — the yaml/CI "unset" idiom). Otherwise the default is ``0`` (never
+    armed) when the effective FIRST platform is cpu — a local backend has no
+    tunnel to wedge, and healthy CPU runs of heavy sections legitimately blow
+    any sane deadline — else ``accel_default_s``. Resolution goes through
+    :func:`effective_first_platform`, so a comma-separated platform list like
+    ``"cpu,host"`` is read the same way everywhere (previously fid_trend /
+    publish_run compared ``jax.config.jax_platforms == "cpu"`` exactly and
+    would arm a 600 s watchdog on such a CPU run — ADVICE r5 item 3).
+    """
+    env = os.environ.get(env_var) or None
+    if env is not None:
+        return float(env)
+    return 0.0 if effective_first_platform() == "cpu" else accel_default_s
+
+
 def probe_marker_path(first: str) -> str:
     """Per-user probe-success marker for platform ``first`` — shared by
     :func:`ensure_live_backend` and the recovery watcher

@@ -124,9 +124,11 @@ def main(argv=None):
                        "aborted": f"stalled {silent_s:.0f}s after {label!r} "
                                   "(wedged-tunnel watchdog)"}, f, indent=1)
 
-    env_stall = os.environ.get("DDIM_COLD_FID_STALL_S")
-    stall_s = float(env_stall) if env_stall else (
-        0.0 if jax.config.jax_platforms == "cpu" else 600.0)
+    # shared arm-condition (utils/platform.watchdog_stall_s): env override,
+    # else disarmed on an effective-cpu platform (comma-list aware), else 600s
+    from ddim_cold_tpu.utils.platform import watchdog_stall_s
+
+    stall_s = watchdog_stall_s("DDIM_COLD_FID_STALL_S", 600.0)
     wd = StallWatchdog(stall_s, on_abort=_write_partial,
                        name="fid-trend").start()
 

@@ -152,13 +152,11 @@ def main(argv=None):
         # one-shot probe can't cover (r05: fid_trend hung exactly there) —
         # the curves/logs above are already published; sampling is the only
         # unbounded device work, so a stall still leaves a partial artifact
-        import jax
-
+        from ddim_cold_tpu.utils.platform import watchdog_stall_s
         from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
-        env_stall = os.environ.get("DDIM_COLD_FID_STALL_S")
-        stall_s = float(env_stall) if env_stall else (
-            0.0 if jax.config.jax_platforms == "cpu" else 600.0)
+        # shared arm-condition (comma-list aware; ADVICE r5 item 3)
+        stall_s = watchdog_stall_s("DDIM_COLD_FID_STALL_S", 600.0)
         wd = StallWatchdog(stall_s, name="publish-run").start()
         render_samples(args.run_dir, out_dir, wd=wd)
         wd.done()

@@ -61,6 +61,7 @@ def test_draft2drawing_cli_smoke(tiny_config, monkeypatch, tmp_path, synthetic_i
         assert (saved / artifact).is_file(), artifact
 
 
+@pytest.mark.isolated
 def test_trainer_launcher_smoke(monkeypatch, tmp_path, synthetic_image_dir):
     """`python multi_gpu_trainer.py <Exp>`: yaml → run dir → train.log +
     dual checkpoints (reference multi_gpu_trainer.py:167-219 surface)."""
@@ -121,6 +122,7 @@ def test_draft2drawing_img2tensor_range(synthetic_image_dir):
     assert x.min() >= -1.0 and x.max() <= 1.0
 
 
+@pytest.mark.isolated
 def test_publish_run_levels_follow_run_config(monkeypatch, tmp_path,
                                               synthetic_image_dir):
     """scripts/publish_run.py on a finished run dir: artifacts appear and the

@@ -229,6 +229,7 @@ def test_gaussian_raw_batch_and_prepare(synthetic_image_dir):
     np.testing.assert_array_equal(np.asarray(noisy), np.asarray(noisy2))
 
 
+@pytest.mark.isolated
 def test_trainer_gaussian_device_path_smoke(tmp_path, synthetic_image_dir):
     """Gaussian + device_degrade trains (device-noised train loader) while
     the val loader stays on the deterministic host path."""
@@ -313,6 +314,7 @@ def test_device_prefetch_propagates_errors():
         list(gen)
 
 
+@pytest.mark.isolated
 def test_trainer_device_path_matches_host_path(tmp_path, synthetic_image_dir):
     """Two 3-step trainer runs — host corruption vs device corruption — land
     on the same loss trajectory, and the async saver leaves both checkpoints."""

@@ -131,6 +131,39 @@ def test_flash_gradient_north_star_shape_matches_dense():
                                    rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
 
 
+def test_flash_bf16_gradient_north_star_shape_matches_dense():
+    """The Pallas BACKWARD on bf16 inputs at the north-star shape (N=2501,
+    H=4, D=64, tuned NS_FLASH_BLOCKS) — against autodiff through the dense
+    f32 oracle on the same bf16 inputs. The 200px training stage runs this
+    exact backward in bf16, and the bf16-gemm-v2 kernel routes its backward
+    GEMMs through the input dtype — a path the f32 gradient tests above
+    never touch (ADVICE r5 item 1: the bf16 backward GEMM path had zero
+    numerics coverage). Tolerances follow the bf16 forward tests (~2e-2):
+    the comparison isolates kernel-vs-einsum error on identical bf16
+    operands, not bf16-vs-f32 rounding."""
+    from bench import NS_FLASH_BLOCKS
+
+    q32, k32, v32 = _rand_qkv(19, 1, 2501, 4, 64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+    scale = 64**-0.5
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, scale, *NS_FLASH_BLOCKS)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        out = _dense_attention_f32(q, k, v, scale)[1]
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_ours = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, ours, want in zip("qkv", g_ours, g_want):
+        assert ours.dtype == jnp.bfloat16, f"d{name} dtype {ours.dtype}"
+        np.testing.assert_allclose(np.asarray(ours, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-2, atol=2e-2, err_msg=f"d{name}")
+
+
 def test_flash_bf16_north_star_headline_config_matches_dense():
     """The EXACT path bench_v2 measures on chip: bf16 inputs, N=2501, H=4,
     D=64, the tuned NS_FLASH_BLOCKS single-chunk config — against the dense

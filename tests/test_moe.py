@@ -176,6 +176,7 @@ def test_expert_parallel_composes_with_sequence_parallel():
         s1.params, s2.params)
 
 
+@pytest.mark.isolated
 def test_moe_trainer_end_to_end(tmp_path, synthetic_image_dir):
     """yaml num_experts=2 trains, evaluates (sow no-op on the immutable
     eval path), and checkpoints — in BOTH block layouts (scan_blocks
@@ -262,6 +263,7 @@ def test_moe_aux_loss_layout_parity():
     np.testing.assert_allclose(float(aux_b), float(aux_a), rtol=1e-6)
 
 
+@pytest.mark.isolated
 def test_expert_mesh_axis_validated(tmp_path, synthetic_image_dir):
     """An 'expert' mesh axis without (divisible) num_experts fails fast."""
     from ddim_cold_tpu.config import load_config
@@ -274,6 +276,7 @@ def test_expert_mesh_axis_validated(tmp_path, synthetic_image_dir):
         run(cfg, str(tmp_path), log_every=2)
 
 
+@pytest.mark.isolated
 def test_moe_bridge_refusal_and_warm_start_fallback(tmp_path,
                                                     synthetic_image_dir):
     """MoE params have no reference torch layout: the pkl bridge refuses

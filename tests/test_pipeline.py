@@ -161,6 +161,7 @@ def test_pipeline_rejects_bad_shapes(scanned_model_and_params):
         pf({"params": params}, x, t)
 
 
+@pytest.mark.isolated
 def test_pipeline_training_end_to_end(tmp_path, synthetic_image_dir):
     """Full trainer run on mesh {data:2, pipe:2}: pipelined step + stage-
     sharded optimizer state + checkpoints."""
@@ -180,6 +181,7 @@ def test_pipeline_training_end_to_end(tmp_path, synthetic_image_dir):
     assert os.path.isdir(os.path.join(result.run_dir, "lastepoch.ckpt"))
 
 
+@pytest.mark.isolated
 def test_pipeline_trainer_composes_with_tp(synthetic_image_dir, tmp_path):
     """YAML mesh {model, pipe} trains end to end (previously rejected):
     layout_for_mesh hands pipeline_param_specs the tensor axes and the
@@ -289,6 +291,7 @@ def test_pipelined_moe_mutable_forms_and_sp_refusal():
         spf({"params": params}, x, t)
 
 
+@pytest.mark.isolated
 def test_pipeline_trainer_composes_with_moe(synthetic_image_dir, tmp_path):
     """YAML mesh {pipe, expert} with num_experts=2 trains end to end
     (previously rejected): layout_for_mesh hands pipeline_param_specs the
@@ -345,6 +348,7 @@ def test_pipelined_composes_with_sp_and_tp(scanned_model_and_params):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+@pytest.mark.isolated
 def test_pipeline_trainer_composes_with_sp(synthetic_image_dir, tmp_path):
     """YAML mesh {seq, pipe} trains end to end under BOTH sp strategies
     (previously rejected outright): ring rotation and the ulysses

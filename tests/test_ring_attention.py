@@ -164,6 +164,7 @@ def test_trainer_builds_seq_parallel_model():
     assert plain.seq_mesh is None
 
 
+@pytest.mark.isolated
 def test_seq_parallel_training_end_to_end(tmp_path, synthetic_image_dir):
     """Full trainer run on mesh {data:4, seq:2} (regression: init crashed when
     the sample batch wasn't divisible over the data axis) and {seq:8} (pure sp,

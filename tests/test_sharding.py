@@ -157,6 +157,7 @@ def test_param_partition_specs_rules():
     assert specs["patch_embed"]["proj"]["kernel"] == P()
 
 
+@pytest.mark.isolated
 def test_trainer_multidevice_eval_ragged_tail(tmp_path, synthetic_image_dir):
     """End-to-end trainer on a data=4 mesh where the eval set does NOT divide
     the global batch — the padded eval path must not crash (regression:

@@ -1,0 +1,256 @@
+"""Step-cache subsystem tests (ops/step_cache.py + the vit.py hooks).
+
+The contract under test, in order of strictness:
+* interval=1 routes around the cache machinery entirely — BITWISE equal to
+  the plain sampler (the dispatch in sampling.ddim_sample/cold_sample);
+* a refresh forward (capture_split) computes the exact plain forward while
+  emitting the half-trunk deltas (bitwise on the image output);
+* a reuse forward never executes the skipped blocks — proven functionally:
+  its output is invariant to arbitrary perturbation of their params;
+* the refresh→reuse round trip reproduces the plain forward to float
+  round-off (a + (b − a) ≠ b bitwise, so this one is allclose, not equal);
+* the schedule is static: one XLA compile per (k, interval, mode);
+* SPMD cached sampling over a data mesh matches single-device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.models import DiffusionViT
+from ddim_cold_tpu.ops import sampling, schedule, step_cache
+
+T = 2000
+# depth 4: distinct front (0,1) / rear (2,3) halves, so a delta-mode reuse
+# still runs real blocks and param-invariance has something to bite on
+TINY4 = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=4,
+             num_heads=4, total_steps=T)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = DiffusionViT(**TINY4)
+    x = jnp.zeros((2, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x,
+                        jnp.array([0, 1], jnp.int32))["params"]
+    return model, params
+
+
+# ---------------------------------------------------------------- schedule
+
+def test_branch_sequence_delta_phase_split():
+    seq = schedule.cache_branch_sequence(10, 2, "delta")
+    assert seq.dtype == np.int32
+    # refreshes at every interval-th step, reuse between; early half reuses
+    # the REAR delta (branch 1), late half the FRONT (branch 2)
+    assert list(seq) == [0, 1, 0, 1, 0, 2, 0, 2, 0, 2]
+
+
+def test_branch_sequence_full_mode_and_intervals():
+    assert list(schedule.cache_branch_sequence(6, 2, "full")) == [0, 1] * 3
+    assert list(schedule.cache_branch_sequence(7, 3, "full")) == [
+        0, 1, 1, 0, 1, 1, 0]
+    # interval <= 1: every step refreshes (the exact sampler)
+    assert list(schedule.cache_branch_sequence(4, 1)) == [0] * 4
+    assert list(schedule.cache_branch_sequence(4, 0)) == [0] * 4
+    with pytest.raises(ValueError):
+        schedule.cache_branch_sequence(4, 2, "bogus")
+
+
+def test_cache_spec_validation():
+    spec = step_cache.cache_spec(4, 10, 2, "delta")
+    assert spec.split == 2 and spec.n_steps == 10 and spec.interval == 2
+    hash(spec)  # must stay hashable — it rides jit static args
+    with pytest.raises(ValueError):
+        step_cache.cache_spec(1, 10, 2)  # no half to skip
+    with pytest.raises(ValueError):
+        step_cache.cache_spec(4, 10, 2, split=0)
+    with pytest.raises(ValueError):
+        step_cache.cache_spec(4, 10, 2, split=4)
+
+
+def test_flops_saved_fraction():
+    # interval=2, 10 steps: 5 reuse steps skipping half the trunk → 25%
+    assert step_cache.flops_saved_fraction(
+        step_cache.cache_spec(4, 10, 2, "delta")) == pytest.approx(0.25)
+    # full mode skips the whole trunk on reuse steps → 50%
+    assert step_cache.flops_saved_fraction(
+        step_cache.cache_spec(4, 10, 2, "full")) == pytest.approx(0.5)
+    assert step_cache.flops_saved_fraction(
+        step_cache.cache_spec(4, 10, 1)) == 0.0
+    assert not step_cache.enabled(1) and step_cache.enabled(2)
+
+
+# ---------------------------------------------------------- model-level hooks
+
+def test_capture_split_forward_is_bitwise_plain(model_and_params):
+    """A refresh step must cost nothing in exactness: same blocks, same
+    order, deltas read off the already-computed token stream."""
+    model, params = model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    t = jnp.array([100, 100], jnp.int32)
+    plain = model.apply({"params": params}, x, t)
+    out, (d_front, d_rear) = model.apply({"params": params}, x, t,
+                                         capture_split=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(plain))
+    assert d_front.shape == d_rear.shape == (2, model.num_patches + 1,
+                                             model.embed_dim)
+
+
+def test_skip_with_true_delta_matches_plain(model_and_params):
+    """Refresh → reuse round trip: skipping a half and adding its captured
+    delta reproduces the plain forward to float round-off."""
+    model, params = model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
+    t = jnp.array([50, 50], jnp.int32)
+    plain = np.asarray(model.apply({"params": params}, x, t))
+    _, (d_front, d_rear) = model.apply({"params": params}, x, t,
+                                       capture_split=2)
+    for skip, delta in (((0, 2), d_front), ((2, 4), d_rear),
+                        ((0, 4), d_front + d_rear)):
+        out = model.apply({"params": params}, x, t, skip_blocks=skip,
+                          block_delta=delta)
+        np.testing.assert_allclose(np.asarray(out), plain,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_reuse_step_never_runs_skipped_blocks(model_and_params):
+    """Functional proof that skipped blocks don't execute: a reuse forward is
+    BITWISE invariant to arbitrary perturbation of their params, while the
+    same perturbation on an executed block changes the output."""
+    model, params = model_and_params
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16, 3))
+    t = jnp.array([10, 10], jnp.int32)
+    delta = jnp.zeros((2, model.num_patches + 1, model.embed_dim),
+                      model.dtype)
+
+    def wreck(p, block_name):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, a: a + 1e3 if any(
+                getattr(k, "key", None) == block_name for k in path) else a, p)
+
+    base = np.asarray(model.apply({"params": params}, x, t,
+                                  skip_blocks=(2, 4), block_delta=delta))
+    for name in ("blocks_2", "blocks_3"):
+        out = np.asarray(model.apply({"params": wreck(params, name)}, x, t,
+                                     skip_blocks=(2, 4), block_delta=delta))
+        np.testing.assert_array_equal(out, base)
+    # sanity: the same perturbation on an EXECUTED block must show up
+    out = np.asarray(model.apply({"params": wreck(params, "blocks_0")}, x, t,
+                                 skip_blocks=(2, 4), block_delta=delta))
+    assert np.abs(out - base).max() > 0
+
+
+def test_hook_validation(model_and_params):
+    model, params = model_and_params
+    x = jnp.zeros((1, 16, 16, 3))
+    t = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(ValueError, match="block_delta"):
+        model.apply({"params": params}, x, t, skip_blocks=(0, 2))
+    with pytest.raises(ValueError, match="capture_split"):
+        model.apply({"params": params}, x, t, capture_split=0)
+    with pytest.raises(ValueError):
+        model.apply({"params": params}, x, t, skip_blocks=(0, 2),
+                    block_delta=jnp.zeros(
+                        (1, model.num_patches + 1, model.embed_dim)),
+                    capture_split=2)
+    scan_model = DiffusionViT(scan_blocks=True, **TINY4)
+    sp = scan_model.init(jax.random.PRNGKey(0), x, t)["params"]
+    with pytest.raises(ValueError, match="scan_blocks"):
+        scan_model.apply({"params": sp}, x, t, capture_split=2)
+
+
+# ------------------------------------------------------------------ samplers
+
+def test_interval_one_is_bitwise_exact(model_and_params):
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(5)
+    plain = sampling.ddim_sample(model, params, rng, k=400, n=2)
+    routed = sampling.ddim_sample(model, params, rng, k=400, n=2,
+                                  cache_interval=1, cache_mode="full")
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(routed))
+    cold_plain = sampling.cold_sample(model, params, rng, n=2, levels=4)
+    cold_routed = sampling.cold_sample(model, params, rng, n=2, levels=4,
+                                       cache_interval=1)
+    np.testing.assert_array_equal(np.asarray(cold_plain),
+                                  np.asarray(cold_routed))
+
+
+@pytest.mark.parametrize("mode", ["delta", "full"])
+def test_cached_ddim_close_to_exact(model_and_params, mode):
+    """interval=2 on a tiny random-init model: the cached sampler must stay
+    in range and near the exact one (the quantitative FID bound is bench's
+    cached_quality section; here we pin basic sanity + determinism)."""
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(6)
+    exact = np.asarray(sampling.ddim_sample(model, params, rng, k=200, n=2))
+    cached = np.asarray(sampling.ddim_sample(model, params, rng, k=200, n=2,
+                                             cache_interval=2,
+                                             cache_mode=mode))
+    assert np.isfinite(cached).all()
+    assert cached.min() >= 0.0 and cached.max() <= 1.0
+    assert np.abs(cached - exact).max() < 0.25  # near, not equal
+    again = np.asarray(sampling.ddim_sample(model, params, rng, k=200, n=2,
+                                            cache_interval=2,
+                                            cache_mode=mode))
+    np.testing.assert_array_equal(cached, again)  # deterministic
+
+
+def test_cached_sequence_last_frame_matches_image(model_and_params):
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(7)
+    seq = sampling.ddim_sample(model, params, rng, k=500, n=2,
+                               return_sequence=True, cache_interval=2)
+    img = sampling.ddim_sample(model, params, rng, k=500, n=2,
+                               cache_interval=2)
+    assert seq.shape[0] == 5  # init + 4 steps
+    np.testing.assert_allclose(np.asarray(seq[-1]), np.asarray(img),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cached_cold_and_eta_paths(model_and_params):
+    model, params = model_and_params
+    rng = jax.random.PRNGKey(8)
+    cold = np.asarray(sampling.cold_sample(model, params, rng, n=2, levels=4,
+                                           cache_interval=2))
+    assert np.isfinite(cold).all() and cold.min() >= 0.0 and cold.max() <= 1.0
+    stoch = np.asarray(sampling.ddim_sample(model, params, rng, k=500, n=2,
+                                            eta=0.5, cache_interval=2))
+    assert np.isfinite(stoch).all()
+
+
+def test_one_compile_per_schedule(model_and_params):
+    """The refresh/reuse pattern is a scanned input, not a trace condition:
+    re-sampling with new rngs must not re-trace, and only (k, interval,
+    mode) changes may add compilation cache entries."""
+    model, params = model_and_params
+    fn = sampling._ddim_scan_cached
+    fn.clear_cache()
+    for seed in (10, 11, 12):
+        sampling.ddim_sample(model, params, jax.random.PRNGKey(seed),
+                             k=400, n=2, cache_interval=2)
+    assert fn._cache_size() == 1
+    sampling.ddim_sample(model, params, jax.random.PRNGKey(10), k=400, n=2,
+                         cache_interval=3)
+    assert fn._cache_size() == 2
+    sampling.ddim_sample(model, params, jax.random.PRNGKey(10), k=400, n=2,
+                         cache_interval=2, cache_mode="full")
+    assert fn._cache_size() == 3
+
+
+def test_mesh_sharded_cached_sampling_matches_single_device(model_and_params):
+    """SPMD cached sampling: the cache shards ride the data axis next to the
+    batch (step_cache.shard_cache) and reproduce the single-device result."""
+    from ddim_cold_tpu.parallel.mesh import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh({"data": 8})
+    rng = jax.random.PRNGKey(9)
+    single = np.asarray(sampling.ddim_sample(model, params, rng, k=500, n=8,
+                                             cache_interval=2))
+    sharded = sampling.ddim_sample(model, params, rng, k=500, n=8,
+                                   cache_interval=2, mesh=mesh)
+    assert len(sharded.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(sharded), single,
+                               rtol=2e-5, atol=2e-6)

@@ -96,6 +96,7 @@ def trained_run(tmp_path_factory, synthetic_image_dir):
     return base, cfg, result
 
 
+@pytest.mark.isolated
 def test_train_end_to_end(trained_run):
     base, cfg, result = trained_run
     assert result.steps == 2 * (10 // 2)  # 2 epochs × 5 batches
@@ -112,6 +113,7 @@ def test_train_end_to_end(trained_run):
     assert os.path.isfile(os.path.join(run_dir, "metrics.jsonl"))
 
 
+@pytest.mark.isolated
 def test_snapshot_epochs_writes_trend_checkpoints(trained_run):
     """snapshot_epochs=N saves bare params to snapshots/epoch_<E> — the
     per-checkpoint FID-trend source (scripts/fid_trend.py collect_points)."""
@@ -127,6 +129,7 @@ def test_snapshot_epochs_writes_trend_checkpoints(trained_run):
     assert jax.tree.structure(raw) == jax.tree.structure(best)  # bare params
 
 
+@pytest.mark.isolated
 def test_resume_continues(trained_run, synthetic_image_dir):
     from ddim_cold_tpu.train.trainer import run
 
@@ -212,6 +215,7 @@ def _sigterm_when(log_path, needle, timeout_s=120):
     return t
 
 
+@pytest.mark.isolated
 def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
     """SIGTERM mid-training → the loop finishes the step, evaluates, saves
     both checkpoints, and run() returns normally (a hard kill would lose the
@@ -232,6 +236,7 @@ def test_sigterm_checkpoints_and_exits(tmp_path, synthetic_image_dir):
     assert os.path.isdir(os.path.join(result.run_dir, "lastepoch.ckpt"))
 
 
+@pytest.mark.isolated
 def test_sigterm_with_short_epochs_stops_at_epoch_end(tmp_path,
                                                       synthetic_image_dir):
     """A stop signal must take effect at the next EPOCH boundary even when
@@ -343,6 +348,7 @@ def test_steps_per_dispatch_matches_sequential():
     assert int(multi_state.step) == int(seq_state.step) == 4
 
 
+@pytest.mark.isolated
 def test_steps_per_dispatch_trainer_run(tmp_path, synthetic_image_dir):
     """The trainer wires config.steps_per_dispatch end to end: grouped
     loader, grouped sharding, boundary-crossing step logs, finite losses."""
@@ -502,6 +508,7 @@ def test_smooth_l1_matches_torch():
     assert got == pytest.approx(want, rel=1e-6)
 
 
+@pytest.mark.isolated
 def test_profile_steps_writes_trace(tmp_path, synthetic_image_dir):
     """profile_steps traces the first N steps into <run_dir>/trace and the
     run completes normally (reference had only wall-clock prints)."""
@@ -521,6 +528,7 @@ def test_profile_steps_writes_trace(tmp_path, synthetic_image_dir):
     assert any(f for _, _, fs in os.walk(trace_dir) for f in fs), "empty trace"
 
 
+@pytest.mark.isolated
 def test_steps_per_dispatch_rejects_indivisible_max_steps(tmp_path,
                                                           synthetic_image_dir):
     """max_steps not a multiple of steps_per_dispatch fails loud (ADVICE r4):
@@ -579,6 +587,7 @@ def test_ema_step_math():
     assert off2.ema_params is None
 
 
+@pytest.mark.isolated
 def test_ema_trainer_checkpoints_and_resume(tmp_path, synthetic_image_dir):
     """ema_decay in the yaml: bestloss_ema.ckpt appears, lastepoch carries
     the shadow, resume restores it, and resuming an ema-less checkpoint
@@ -616,6 +625,7 @@ def test_ema_trainer_checkpoints_and_resume(tmp_path, synthetic_image_dir):
     assert "re-seeding" not in open(os.path.join(r2.run_dir, "train.log")).read()
 
 
+@pytest.mark.isolated
 def test_ema_resume_from_pre_ema_checkpoint(tmp_path, synthetic_image_dir):
     """Turning ema_decay on mid-run (resume from a checkpoint written without
     it) re-seeds the shadow from the restored params with a log note. Own
@@ -642,6 +652,7 @@ def test_ema_resume_from_pre_ema_checkpoint(tmp_path, synthetic_image_dir):
     assert "ema_params" in last
 
 
+@pytest.mark.isolated
 def test_ema_off_resume_from_ema_checkpoint(tmp_path, synthetic_image_dir):
     """The reverse toggle: a checkpoint written WITH ema_params resumes
     cleanly under ema_decay=0 (the shadow is dropped with a log note) —
@@ -666,6 +677,7 @@ def test_ema_off_resume_from_ema_checkpoint(tmp_path, synthetic_image_dir):
     assert "ema_params" not in last
 
 
+@pytest.mark.isolated
 def test_warm_start_shape_mismatch_fails_loudly(tmp_path, synthetic_image_dir):
     """A stale `initializing` pkl from a different model config must raise a
     clear error naming the mismatched leaves — not surface later as an opaque
@@ -710,6 +722,7 @@ def test_ema_decay_range_validated(tmp_path, synthetic_image_dir):
             load_config(path, "exp")
 
 
+@pytest.mark.isolated
 def test_resume_shape_mismatch_fails_loudly(tmp_path, synthetic_image_dir):
     """`resume:` pointing at a different-config run's lastepoch.ckpt raises
     the clear mismatch error (same guard as warm-start), not an opaque jit
@@ -766,6 +779,7 @@ def test_grad_accum_matches_unaccumulated_step():
             tree1, tree4)
 
 
+@pytest.mark.isolated
 def test_grad_accum_config_validation(tmp_path, synthetic_image_dir):
     """grad_accum < 1 fails at config load; grad_accum with a pipe mesh is
     rejected (the pipeline has its own microbatching)."""
@@ -781,6 +795,7 @@ def test_grad_accum_config_validation(tmp_path, synthetic_image_dir):
         run(cfg, str(tmp_path), log_every=2)
 
 
+@pytest.mark.isolated
 def test_grad_accum_trainer_end_to_end(tmp_path, synthetic_image_dir):
     """A short run with grad_accum=2 trains, logs, and checkpoints normally."""
     from ddim_cold_tpu.train.trainer import run
