@@ -162,6 +162,18 @@ def main(argv=None):
                          "flash kernel under alternative (block_q, block_kv) "
                          "choices — kernel tuning for the 200px config; a "
                          "few extra compiles of chip time")
+    ap.add_argument("--serving", action="store_true",
+                    help="run the serving-engine leg (ddim_cold_tpu/serve): "
+                         "bucketed continuous batching over a mixed request "
+                         "stream after AOT warmup — records sustained img/s, "
+                         "p50/p95 request latency, queue depth and "
+                         "compiles-after-warmup; composes with --smoke for "
+                         "a CPU-budget run")
+    ap.add_argument("--xla-blockwise", action="store_true",
+                    help="also time the pure-XLA blockwise attention leg in "
+                         "the north-star section (retired from the default "
+                         "set in r06 — 3.03 img/s vs 5.19 dense in BENCH_r05; "
+                         "it only existed as a Mosaic-rejection hedge)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (env JAX_PLATFORMS can be "
                          "overridden by site config; this flag always wins)")
@@ -579,6 +591,66 @@ def main(argv=None):
         if args.ksweep:
             section("ksweep", run_ksweep)
 
+        def run_serving():
+            # the serving subsystem (ddim_cold_tpu/serve): bucketed
+            # continuous batching + AOT warmup. The engine must sustain
+            # ≥ 0.9× the raw one-shot sampler's img/s at the same bucket
+            # size while absorbing a MIXED request-size stream (coalescing,
+            # padding, one request split across batches) with zero
+            # serve-time compiles — overlap and batching pay for the
+            # queueing machinery, or this leg says so.
+            from ddim_cold_tpu import serve
+
+            buckets = (2, 4) if args.smoke else (8, 32)
+            k_serve = 400 if args.smoke else 20
+            bmax = max(buckets)
+            cfg = serve.SamplerConfig(k=k_serve)
+            engine = serve.Engine(model, state.params, buckets=buckets)
+            mark(f"serving warmup buckets={buckets}", budget_s=2 * stall_s)
+            wu = serve.warmup(engine, [cfg])
+            # mixed sizes (one above bmax → forced split) summing to a bucket
+            # multiple: zero pad rows, so the one-shot comparison below is
+            # packing/overlap overhead only, not padding waste
+            sizes = [bmax + 1, 1, bmax // 2, bmax, bmax // 2 - 1, bmax - 1]
+            short = -(-sum(sizes) // bmax) * bmax - sum(sizes)
+            if short:
+                sizes.append(short)
+            best = None
+            for rep in range(2):  # keep the faster drain (time_ddim's rule)
+                mark(f"serving drain rep {rep}")
+                for i, n_req in enumerate(sizes):
+                    engine.submit(seed=100 + i, n=n_req, config=cfg)
+                report = engine.run()
+                if best is None or report["img_per_sec"] > best["img_per_sec"]:
+                    best = report
+            oneshot_t = time_ddim(model, state.params, k_serve, bmax,
+                                  "serving one-shot")
+            oneshot_ips = bmax / oneshot_t
+            sub["serving"] = {
+                "img_per_sec": round(best["img_per_sec"], 2),
+                "oneshot_img_per_sec": round(oneshot_ips, 2),
+                "vs_oneshot": round(best["img_per_sec"] / oneshot_ips, 3),
+                "p50_latency_s": round(best["latency"]["p50_s"], 4),
+                "p95_latency_s": round(best["latency"]["p95_s"], 4),
+                "max_queue_depth": best["max_queue_depth"],
+                "compiles_after_warmup": best["compiles"],
+                "batches": best["batches"], "rows": best["rows"],
+                "padded_rows": best["padded_rows"],
+                "buckets": list(buckets), "k": k_serve,
+                "warmup": {"new_compiles": wu["new_compiles"],
+                           "programs": wu["programs"],
+                           "cache_dir": wu["cache_dir"]},
+            }
+            log(f"serving: {best['img_per_sec']:.2f} img/s over "
+                f"{best['rows']} rows ({best['batches']} batches, "
+                f"{best['padded_rows']} pad) vs one-shot {oneshot_ips:.2f} "
+                f"img/s at n={bmax} → ratio "
+                f"{sub['serving']['vs_oneshot']}; compiles after warmup: "
+                f"{best['compiles']}")
+
+        if args.serving:
+            section("serving", run_serving)
+
         # 200px north-star state, shared across run_northstar, the cached
         # legs and run_northstar_profile: the 200px param init is one of the
         # bench's longer silent windows and must be paid once, not re-paid
@@ -611,8 +683,14 @@ def main(argv=None):
             # reject once at this exact shape, r03). Each leg is its own
             # best-effort section-within-a-section via time_ddim's memo.
             flash_exc = None
-            for impl, suffix in ((False, "_dense"), (True, "_flash"),
-                                 ("xla", "_xla")):
+            impls = [(False, "_dense"), (True, "_flash")]
+            if args.xla_blockwise:
+                # retired from the default set (PERF.md "Attention paths"):
+                # measured well behind dense AND flash at the north-star
+                # shape, and the Mosaic rejection it hedged has not recurred
+                # since the kernel-rev guard landed
+                impls.append(("xla", "_xla"))
+            for impl, suffix in impls:
                 ns_model = (ns_flash_model() if impl is True else DiffusionViT(
                     dtype=jnp.bfloat16, use_flash=impl, flash_blocks=None,
                     **MODEL_CONFIGS["oxford_flower_200_p4"]))

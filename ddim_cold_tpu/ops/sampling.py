@@ -82,7 +82,8 @@ def _ddim_scan_sequence(model, params, x_init, noise_rng, *, k: int,
     return (frames + 1.0) / 2.0
 
 
-@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta"))
+@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta"),
+         donate_argnames=("x_init",))
 def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
                     t_start: Optional[int], eta: float = 0.0):
     coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
@@ -103,17 +104,21 @@ def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
     return (x0_last + 1.0) / 2.0
 
 
-@partial(jax.jit, static_argnames=("model", "k", "t_start", "eta",
-                                   "cache_interval", "cache_mode", "sequence"))
-def _ddim_scan_cached(model, params, x_init, noise_rng, cache0, *, k: int,
+def _ddim_cached_impl(model, params, x_init, noise_rng, cache0, *, k: int,
                       t_start: Optional[int], eta: float,
                       cache_interval: int, cache_mode: str, sequence: bool):
     """The feature-cached DDIM scan (ops/step_cache.py): same affine update
     as the plain scans, but the model evaluation routes through a
     ``lax.switch`` over the static refresh/reuse schedule and the block-delta
-    cache rides the carry. One variant serves both the last-only and
+    cache rides the carry. One impl serves both the last-only and
     sequence-returning paths (``sequence`` is static) so the cached and exact
-    samplers can never drift onto different update algebra."""
+    samplers can never drift onto different update algebra.
+
+    Returns ``(images, final_cache)``: the cache comes back out so the
+    donated ``cache0`` buffers alias it (free at the XLA level — the carry is
+    already materialized) and so a serving loop can recycle one cache
+    allocation across dispatches (the schedule's step 0 always refreshes, so
+    stale contents are never read; serve/engine.py does exactly this)."""
     coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
     spec = step_cache.cache_spec(model.depth, len(coeffs.t_seq),
                                  cache_interval, cache_mode)
@@ -130,12 +135,26 @@ def _ddim_scan_cached(model, params, x_init, noise_rng, cache0, *, k: int,
 
     carry0 = (x_init, jnp.zeros_like(x_init), cache0)
     branches = jnp.asarray(spec.branches, jnp.int32)
-    (_, x0_last, _), x0_out = jax.lax.scan(
+    (_, x0_last, cache_out), x0_out = jax.lax.scan(
         step, carry0, (_scan_inputs(coeffs), branches))
     if sequence:
         frames = jnp.concatenate([x_init[None], x0_out], axis=0)
-        return (frames + 1.0) / 2.0
-    return (x0_last + 1.0) / 2.0
+        return (frames + 1.0) / 2.0, cache_out
+    return (x0_last + 1.0) / 2.0, cache_out
+
+
+_CACHED_STATICS = ("model", "k", "t_start", "eta", "cache_interval",
+                   "cache_mode", "sequence")
+#: last-only entry point — donates x_init and the cache carry (both alias
+#: outputs: the image is x_init-shaped f32, the returned cache matches
+#: cache0), so the sampler never double-buffers x or the deltas in HBM.
+_ddim_scan_cached = jax.jit(_ddim_cached_impl, static_argnames=_CACHED_STATICS,
+                            donate_argnames=("x_init", "cache0"))
+#: sequence entry point — NO donation: the (steps+1, N, H, W, C) frames
+#: output matches neither donated shape, so donation here would only raise
+#: jax's unused-donation warning (the figure path keeps the plain behavior).
+_ddim_scan_cached_seq = jax.jit(_ddim_cached_impl,
+                                static_argnames=_CACHED_STATICS)
 
 
 def _make_cache(model, x_init: jax.Array, mesh) -> step_cache.Cache:
@@ -206,16 +225,24 @@ def ddim_sample(
             raise ValueError("ddim_sample needs either rng or x_init")
         H, W = model.img_size
         x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    elif mesh is None and not return_sequence:
+        # the last-only scans DONATE x_init (no HBM double-buffer); a
+        # caller-provided start must survive the call, so it enters through a
+        # private copy. The mesh path already copies via device_put, and the
+        # sequence scan does not donate.
+        x_init = jnp.array(x_init, copy=True)
     x_init = _shard_init(x_init, mesh)
     # distinct fold: with a fresh start, rng already produced x_init — the
     # per-step noise must not be correlated with it
     noise_rng = (jax.random.fold_in(rng, 0xD1F) if rng is not None
                  else jax.random.PRNGKey(0))
     if step_cache.enabled(cache_interval):
-        return _ddim_scan_cached(
+        fn = _ddim_scan_cached_seq if return_sequence else _ddim_scan_cached
+        out, _ = fn(
             model, params, x_init, noise_rng, _make_cache(model, x_init, mesh),
             k=k, t_start=t_start, eta=eta, cache_interval=cache_interval,
             cache_mode=cache_mode, sequence=return_sequence)
+        return out
     if return_sequence:
         return _ddim_scan_sequence(model, params, x_init, noise_rng,
                                    k=k, t_start=t_start, eta=eta)
@@ -296,8 +323,7 @@ def slerp_interpolate(
                        rng=jax.random.fold_in(rng, 1))
 
 
-@partial(jax.jit, static_argnames=("model", "levels", "return_sequence"))
-def _cold_scan(model, params, x_init, *, levels: int, return_sequence: bool):
+def _cold_impl(model, params, x_init, *, levels: int, return_sequence: bool):
     t_seq = jnp.asarray(schedule.cold_time_sequence(levels))
     n = x_init.shape[0]
 
@@ -315,13 +341,22 @@ def _cold_scan(model, params, x_init, *, levels: int, return_sequence: bool):
     return (x_last + 1.0) / 2.0
 
 
-@partial(jax.jit, static_argnames=("model", "levels", "return_sequence",
-                                   "cache_interval", "cache_mode"))
-def _cold_scan_cached(model, params, x_init, cache0, *, levels: int,
+_COLD_STATICS = ("model", "levels", "return_sequence")
+#: last-only / sequence split mirrors the DDIM scans: only the last-only
+#: entry donates x_init (its image output aliases the buffer; the sequence
+#: frames cannot).
+_cold_scan = jax.jit(_cold_impl, static_argnames=_COLD_STATICS,
+                     donate_argnames=("x_init",))
+_cold_scan_seq = jax.jit(_cold_impl, static_argnames=_COLD_STATICS)
+
+
+def _cold_cached_impl(model, params, x_init, cache0, *, levels: int,
                       return_sequence: bool, cache_interval: int,
                       cache_mode: str):
     """Feature-cached cold-diffusion scan — same naive Algorithm-1 update as
-    ``_cold_scan``, model evaluation routed through the step cache."""
+    ``_cold_scan``, model evaluation routed through the step cache. Returns
+    ``(images, final_cache)`` like ``_ddim_cached_impl`` (donation aliasing +
+    serve-loop cache recycling)."""
     t_seq = jnp.asarray(schedule.cold_time_sequence(levels))
     spec = step_cache.cache_spec(model.depth, levels, cache_interval, cache_mode)
     n = x_init.shape[0]
@@ -335,11 +370,21 @@ def _cold_scan_cached(model, params, x_init, cache0, *, levels: int,
         return (x0, cache), (x0 if return_sequence else None)
 
     branches = jnp.asarray(spec.branches, jnp.int32)
-    (x_last, _), frames = jax.lax.scan(step, (x_init, cache0),
-                                       (t_seq, branches))
+    (x_last, cache_out), frames = jax.lax.scan(step, (x_init, cache0),
+                                               (t_seq, branches))
     if return_sequence:
-        return (jnp.concatenate([x_init[None], frames], axis=0) + 1.0) / 2.0
-    return (x_last + 1.0) / 2.0
+        return ((jnp.concatenate([x_init[None], frames], axis=0) + 1.0) / 2.0,
+                cache_out)
+    return (x_last + 1.0) / 2.0, cache_out
+
+
+_COLD_CACHED_STATICS = ("model", "levels", "return_sequence",
+                        "cache_interval", "cache_mode")
+_cold_scan_cached = jax.jit(_cold_cached_impl,
+                            static_argnames=_COLD_CACHED_STATICS,
+                            donate_argnames=("x_init", "cache0"))
+_cold_scan_cached_seq = jax.jit(_cold_cached_impl,
+                                static_argnames=_COLD_CACHED_STATICS)
 
 
 def cold_sample(
@@ -368,8 +413,12 @@ def cold_sample(
     x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
     x_init = _shard_init(x_init, mesh)
     if step_cache.enabled(cache_interval):
-        return _cold_scan_cached(
+        fn = _cold_scan_cached_seq if return_sequence else _cold_scan_cached
+        out, _ = fn(
             model, params, x_init, _make_cache(model, x_init, mesh),
             levels=levels, return_sequence=return_sequence,
             cache_interval=cache_interval, cache_mode=cache_mode)
-    return _cold_scan(model, params, x_init, levels=levels, return_sequence=return_sequence)
+        return out
+    fn = _cold_scan_seq if return_sequence else _cold_scan
+    return fn(model, params, x_init, levels=levels,
+              return_sequence=return_sequence)

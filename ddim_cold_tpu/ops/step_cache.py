@@ -91,9 +91,11 @@ def cache_spec(depth: int, n_steps: int, cache_interval: int,
 
 def init_cache(n: int, n_tokens: int, embed_dim: int, dtype) -> Cache:
     """Zero-filled cache carry. The schedule's step 0 is always a refresh, so
-    the zeros are never consumed — they only fix the carry's shape/dtype."""
-    z = jnp.zeros((n, n_tokens, embed_dim), dtype)
-    return (z, z)
+    the zeros are never consumed — they only fix the carry's shape/dtype.
+    The two halves must be DISTINCT allocations: the cached samplers donate
+    the carry, and donating one buffer under two arguments is invalid."""
+    return (jnp.zeros((n, n_tokens, embed_dim), dtype),
+            jnp.zeros((n, n_tokens, embed_dim), dtype))
 
 
 def shard_cache(cache: Cache, mesh) -> Cache:

@@ -57,6 +57,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def data_axis_size(mesh: Optional[Mesh]) -> int:
+    """Number of shards a batch's leading dim splits into on this mesh — 1
+    for no mesh or a mesh without a 'data' axis (batch replicated). The serve
+    engine validates its bucket sizes against this: a bucket that does not
+    divide the data axis cannot be placed without a gather."""
+    if mesh is None or "data" not in mesh.shape:
+        return 1
+    return int(mesh.shape["data"])
+
+
 def batch_sharding(mesh: Mesh, grouped: bool = False) -> NamedSharding:
     """Batch arrays shard their leading dim over 'data' (DistributedSampler's
     role, now expressed as a sharding annotation). Meshes without a 'data'

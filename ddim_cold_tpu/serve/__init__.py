@@ -1,0 +1,33 @@
+"""Sampler serving engine — bucketed continuous batching over the jitted scans.
+
+The ROADMAP north star is serving-scale sampling; ops/sampling.py gives one
+fast program per batch shape, and this package turns it into a service loop:
+
+* ``batching``  — request queue → static bucket plans (pad, never recompile)
+* ``engine``    — AOT-compiled dispatch with H2D/D2H–compute overlap
+* ``warmup``    — compile every (config, bucket) program up front + wire the
+                  persistent compilation cache so restarts skip XLA entirely
+
+Quickstart::
+
+    from ddim_cold_tpu import serve
+    eng = serve.Engine(model, params, mesh=None, buckets=(8, 32, 128))
+    serve.warmup(eng, [serve.SamplerConfig(k=10)])
+    t = eng.submit(seed=0, n=5, k=10)     # → Ticket
+    eng.run()                              # drain the queue
+    imgs = t.result()                      # (5, H, W, C) in [0, 1]
+
+Engine output is bitwise identical to a direct ``ddim_sample``/``cold_sample``
+call with the same rng (padding rows discarded) — see engine.py for why.
+"""
+
+from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
+                                          Ticket, cover_rows, plan_batches,
+                                          select_bucket)
+from ddim_cold_tpu.serve.engine import Engine
+from ddim_cold_tpu.serve.warmup import warmup
+
+__all__ = [
+    "BatchPlan", "Engine", "Request", "SamplerConfig", "Ticket",
+    "cover_rows", "plan_batches", "select_bucket", "warmup",
+]

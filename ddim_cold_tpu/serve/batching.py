@@ -1,0 +1,223 @@
+"""Request queue → static bucket plans (the batching half of the engine).
+
+XLA compiles one program per input shape, so a naive server recompiles on
+every new request count. Here requests are coalesced per sampler config and
+packed row-by-row into a small static set of batch buckets (padding the last
+batch with zero rows), so the engine only ever dispatches shapes it compiled
+at warmup. Requests larger than the biggest bucket simply split across
+batches — packing is by ROW RANGE, not whole requests, which is sound because
+every sampler row is computed independently of its batchmates (the trunk is
+per-row: attention mixes tokens within an image, never across the batch), so
+a request's rows are bitwise identical no matter which batch they ride in.
+
+``SamplerConfig`` deliberately has no ``eta``: stochastic DDIM draws
+batch-SHAPED per-step noise (``jax.random.normal(key, x.shape)``), whose
+per-row values depend on the batch size — coalescing would change every
+row. Deterministic sampling (the reference's path) is what serving batches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SAMPLERS = ("ddim", "cold")
+_CACHE_MODES = ("delta", "full")
+
+
+@dataclass(frozen=True)
+class SamplerConfig:
+    """Everything that selects a compiled sampler program (all statics).
+
+    Hashable on purpose: it is half of the engine's program-cache key
+    ``(config, bucket)``. Two requests share a batch iff their configs are
+    equal — mixed configs never coalesce.
+    """
+
+    sampler: str = "ddim"          # "ddim" | "cold"
+    k: int = 10                    # DDIM stride (ignored by cold)
+    t_start: Optional[int] = None  # guided start level (ddim only)
+    levels: int = 6                # cold-diffusion levels (cold only)
+    cache_interval: int = 1        # 1 = exact sampler; >1 = step cache
+    cache_mode: str = "delta"
+
+    def __post_init__(self):
+        if self.sampler not in _SAMPLERS:
+            raise ValueError(f"sampler must be one of {_SAMPLERS}, "
+                             f"got {self.sampler!r}")
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.levels < 1:
+            raise ValueError(f"levels must be >= 1, got {self.levels}")
+        if self.cache_interval < 1:
+            raise ValueError("cache_interval must be >= 1, "
+                             f"got {self.cache_interval}")
+        if self.cache_mode not in _CACHE_MODES:
+            raise ValueError(f"cache_mode must be one of {_CACHE_MODES}, "
+                             f"got {self.cache_mode!r}")
+
+    @property
+    def cached(self) -> bool:
+        return self.cache_interval > 1
+
+
+class Ticket:
+    """Per-request future. The engine delivers row ranges as their batches
+    come off the device (a split request completes over several batches);
+    ``result()`` blocks until every row has landed."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+        self.submit_time = time.perf_counter()
+        self.done_time: Optional[float] = None
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._buf: Optional[np.ndarray] = None
+        self._remaining = int(n)
+
+    def _deliver(self, lo: int, hi: int, rows: np.ndarray) -> bool:
+        """Engine-side: land request rows [lo, hi). True when complete."""
+        with self._lock:
+            if self._buf is None:
+                self._buf = np.empty((self.n,) + rows.shape[1:], rows.dtype)
+            self._buf[lo:hi] = rows
+            self._remaining -= hi - lo
+            done = self._remaining == 0
+        if done:
+            self.done_time = time.perf_counter()
+            self._event.set()
+        return done
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.done_time is None:
+            return None
+        return self.done_time - self.submit_time
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"ticket for {self.n} rows not complete after {timeout}s "
+                f"({self._remaining} rows outstanding) — did Engine.run() run?")
+        return self._buf
+
+
+@dataclass
+class Request:
+    """One queued sampling request (internal to the engine; tests build these
+    directly for planner coverage). ``key`` is the request's jax PRNG key for
+    fresh starts; ``x_init`` the (n, H, W, C) start for guided requests."""
+
+    config: SamplerConfig
+    n: int
+    key: Optional[object] = None
+    x_init: Optional[object] = None
+    ticket: Ticket = field(default_factory=lambda: Ticket(0))
+    # memo for the assembly thread: the request's full x_init drawn ONCE at
+    # its own n (the draw depends on n, slicing does not), shared by every
+    # batch the request's rows land in
+    _x_full: Optional[object] = None
+
+
+@dataclass(frozen=True)
+class BatchPlan:
+    """One device dispatch: ``rows`` real rows padded to ``bucket``.
+
+    ``entries`` = (request, req_lo, req_hi, row_offset): request rows
+    [req_lo, req_hi) occupy batch rows [row_offset, row_offset + hi - lo).
+    """
+
+    config: SamplerConfig
+    bucket: int
+    entries: tuple
+    rows: int
+
+    @property
+    def padded_rows(self) -> int:
+        return self.bucket - self.rows
+
+
+def select_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket that fits ``n`` whole; None when ``n`` exceeds the
+    largest (the planner then splits the request across batches)."""
+    fits = [b for b in buckets if b >= n]
+    return min(fits) if fits else None
+
+
+def cover_rows(rows: int, buckets: Sequence[int]) -> list[int]:
+    """Bucket multiset covering ``rows`` with minimum padding (ties → fewest
+    batches). Greedily peels max-size buckets, then exact DP on the tail:
+    the first reachable sum ≥ the remainder has minimal padding, and the DP
+    carries the minimum batch count to each sum."""
+    bs = sorted({int(b) for b in buckets})
+    if not bs or bs[0] <= 0:
+        raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+    out: list[int] = []
+    remaining = int(rows)
+    bmax = bs[-1]
+    while remaining >= bmax:
+        out.append(bmax)
+        remaining -= bmax
+    if remaining == 0:
+        return out
+    limit = remaining + bmax  # sum ≥ remaining is reachable by this point
+    inf = limit + 1
+    count = [inf] * (limit + 1)
+    choice = [0] * (limit + 1)
+    count[0] = 0
+    for s in range(1, limit + 1):
+        for b in bs:
+            if b <= s and count[s - b] + 1 < count[s]:
+                count[s] = count[s - b] + 1
+                choice[s] = b
+    for s in range(remaining, limit + 1):
+        if count[s] <= limit:
+            tail = []
+            while s:
+                tail.append(choice[s])
+                s -= choice[s]
+            return out + sorted(tail, reverse=True)
+    raise AssertionError("unreachable: limit includes a whole bmax")
+
+
+def plan_batches(requests: Sequence, buckets: Sequence[int]) -> list[BatchPlan]:
+    """Coalesce a FIFO request list into bucket-padded batch plans.
+
+    Requests group by config (first-seen order; FIFO within a group) and the
+    group's total rows are covered by ``cover_rows``; rows then pack densely
+    into the chosen buckets in request order, splitting requests at batch
+    boundaries. Only the LAST batch of a group carries padding.
+    """
+    groups: dict[SamplerConfig, list] = {}
+    for req in requests:
+        if req.n < 1:
+            raise ValueError(f"request must have n >= 1, got {req.n}")
+        groups.setdefault(req.config, []).append(req)
+
+    plans: list[BatchPlan] = []
+    for config, reqs in groups.items():
+        total = sum(r.n for r in reqs)
+        sizes = cover_rows(total, buckets)
+        it = iter(reqs)
+        req, lo = next(it), 0
+        for bucket in sizes:
+            entries, offset = [], 0
+            while offset < bucket and req is not None:
+                take = min(req.n - lo, bucket - offset)
+                entries.append((req, lo, lo + take, offset))
+                offset += take
+                lo += take
+                if lo == req.n:
+                    req, lo = next(it, None), 0
+            plans.append(BatchPlan(config=config, bucket=bucket,
+                                   entries=tuple(entries), rows=offset))
+        assert req is None, "cover_rows under-covered the group"
+    return plans

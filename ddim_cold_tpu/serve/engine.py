@@ -1,0 +1,324 @@
+"""AOT-compiled dispatch loop: the engine half of the serving subsystem.
+
+Throughput comes from three structural moves, none of which touch the math:
+
+* **Zero serve-time compiles** — every (config, bucket) pair is compiled
+  ahead of time via the jitted scans' AOT path (``.lower(...).compile()``)
+  and dispatch only ever calls those executables. A compiled executable can
+  NOT retrace — a shape it wasn't built for raises instead of silently
+  recompiling — so "no compiles after warmup" is structural, not hopeful.
+  ``stats["compiles"]`` counts program builds; after ``warmup()`` it must
+  not move.
+
+* **Transfer/compute overlap** — batch assembly (per-request init draws, the
+  guided path's H2D upload, padding, mesh placement) runs ``depth`` batches
+  ahead in a background thread (the ``device_prefetch`` machinery from
+  data/loader.py), while the main loop keeps a small in-flight window of
+  dispatched batches and fetches batch n−w (D2H) while the device scans
+  batch n. JAX dispatch is async, so the three phases pipeline.
+
+* **Buffer donation** — the scans donate ``x_init`` and the step-cache
+  carry (ops/sampling.py), so a dispatch peaks at one x-sized buffer, and
+  the engine recycles the returned cache as the next batch's donated
+  ``cache0`` (legal: the cache schedule's step 0 always refreshes, so stale
+  contents are never read) — cached serving allocates its cache once per
+  bucket, ever.
+
+**Bitwise contract.** Engine output rows are bitwise identical to a direct
+``ddim_sample``/``cold_sample``/``sample_from`` call with the same request
+rng: the engine draws each request's init at the request's OWN ``n`` with the
+request's own key (exactly the draw the direct call makes — the values depend
+on ``n``), and row slices of that draw keep their bits; every sampler row is
+then computed independently of its batchmates (per-row trunk), so neither
+coalescing, padding, nor splitting changes a single bit. This holds for the
+deterministic samplers only — which is why ``SamplerConfig`` has no ``eta``
+(batch-shaped noise draws break row invariance) — and exactly per-backend
+(a mesh reduces in a different order than one device; same as training).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.data.loader import device_prefetch
+from ddim_cold_tpu.ops import sampling, step_cache
+from ddim_cold_tpu.parallel.mesh import batch_sharding, data_axis_size, shard_params
+from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
+                                          Ticket, plan_batches)
+from ddim_cold_tpu.utils.profiling import latency_summary
+
+
+class Engine:
+    """Bucketed continuous-batching sampler server.
+
+    ::
+
+        eng = Engine(model, params, mesh=mesh, buckets=(8, 32, 128))
+        serve.warmup(eng, [SamplerConfig(k=10)])
+        tickets = [eng.submit(seed=s, n=5) for s in range(40)]
+        eng.run()
+        imgs = tickets[0].result()   # (5, H, W, C) in [0, 1]
+
+    ``submit`` is thread-safe and returns immediately; ``run`` drains the
+    queue (requests submitted mid-run join the next planning round).
+    """
+
+    def __init__(self, model, params, mesh=None,
+                 buckets: Sequence[int] = (8, 32, 128), *,
+                 prefetch_depth: int = 2, inflight: int = 2):
+        self.model = model
+        self.mesh = mesh
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets!r}")
+        shards = data_axis_size(mesh)
+        bad = [b for b in self.buckets if b % shards]
+        if bad:
+            raise ValueError(
+                f"buckets {bad} do not divide the mesh data axis ({shards}); "
+                "sharded placement needs even divisibility")
+        self.params = shard_params(params, mesh) if mesh is not None else params
+        self.prefetch_depth = int(prefetch_depth)
+        self.inflight = max(1, int(inflight))
+        # any key works here: the deterministic scans never read noise_rng
+        # (eta is pinned to 0.0 at program build — see module docstring)
+        self._key0 = jax.random.PRNGKey(0)
+        self._programs: dict = {}
+        self._spare_caches: dict = {}  # bucket -> recycled step-cache carry
+        self._pending: list[Request] = []
+        self._lock = threading.Lock()
+        self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
+                      "padded_rows": 0, "max_queue_depth": 0,
+                      "latencies_s": []}
+
+    # ---------------------------------------------------------------- submit
+
+    def submit(self, seed: Optional[int] = None, n: int = 1, *,
+               rng: Optional[jax.Array] = None,
+               x_init: Optional[np.ndarray] = None,
+               config: Optional[SamplerConfig] = None, **kwargs) -> Ticket:
+        """Queue a sampling request; returns its :class:`Ticket`.
+
+        Fresh starts pass ``seed`` (or a jax ``rng`` key) — the engine draws
+        the same init the direct sampler would from that key. Guided requests
+        pass ``x_init`` (an (n, H, W, C) or (H, W, C) encoded start; pair it
+        with ``t_start`` — the ``sample_from`` path). Sampler options go in
+        ``config`` or as keyword args (``k=, t_start=, cache_interval=, …``).
+        """
+        if config is None:
+            config = SamplerConfig(**kwargs)
+        elif kwargs:
+            raise ValueError(f"pass config OR keyword options, not both: {kwargs}")
+        if x_init is not None:
+            if config.sampler != "ddim":
+                raise ValueError("guided starts (x_init) are a DDIM path; "
+                                 "cold sampling has no encoded-start analogue")
+            x_init = np.asarray(x_init, np.float32)
+            if x_init.ndim == 3:
+                x_init = x_init[None]
+            if x_init.ndim != 4:
+                raise ValueError(f"x_init must be (n, H, W, C) or (H, W, C), "
+                                 f"got shape {x_init.shape}")
+            n = x_init.shape[0]
+            key = None
+        else:
+            if rng is None:
+                if seed is None:
+                    raise ValueError("fresh requests need seed= or rng=")
+                rng = jax.random.PRNGKey(int(seed))
+            key = rng
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        req = Request(config=config, n=int(n), key=key, x_init=x_init,
+                      ticket=Ticket(n))
+        with self._lock:
+            self._pending.append(req)
+            depth = len(self._pending)
+        self.stats["max_queue_depth"] = max(self.stats["max_queue_depth"], depth)
+        return req.ticket
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------------- programs
+
+    def ensure_program(self, config: SamplerConfig, bucket: int):
+        """The ONLY compile site. Dispatch calls this too — a serve-time miss
+        (a config/bucket warmup didn't cover) compiles and is counted, so
+        ``stats['compiles']`` staying flat after warmup proves zero serve-time
+        compiles."""
+        key = (config, bucket)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build_program(config, bucket)
+            self._programs[key] = prog
+            self.stats["compiles"] += 1
+        return prog
+
+    def _x_struct(self, bucket: int):
+        H, W = self.model.img_size
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        return jax.ShapeDtypeStruct((bucket, H, W, self.model.in_chans),
+                                    jnp.float32, sharding=sharding)
+
+    def _cache_struct(self, bucket: int):
+        shape = (bucket, self.model.num_patches + 1, self.model.embed_dim)
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        s = jax.ShapeDtypeStruct(shape, self.model.dtype, sharding=sharding)
+        return (s, s)
+
+    def _build_program(self, config: SamplerConfig, bucket: int):
+        """AOT-compile the scan for this (config, bucket): trace with shape
+        structs (no dummy allocation), compile, return the executable. The
+        executable is called with the NON-static args only (params, x, …)."""
+        x = self._x_struct(bucket)
+        if config.sampler == "cold":
+            if config.cached:
+                return _cold_cached_lower(self.model, self.params, x,
+                                          self._cache_struct(bucket), config)
+            return sampling._cold_scan.lower(
+                self.model, self.params, x, levels=config.levels,
+                return_sequence=False).compile()
+        if config.cached:
+            return _ddim_cached_lower(self.model, self.params, x, self._key0,
+                                      self._cache_struct(bucket), config)
+        return sampling._ddim_scan_last.lower(
+            self.model, self.params, x, self._key0, k=config.k,
+            t_start=config.t_start, eta=0.0).compile()
+
+    # ------------------------------------------------------------- assembly
+
+    def _request_init(self, req: Request) -> jax.Array:
+        """The request's full init, drawn once at the request's own n —
+        bitwise the direct sampler's draw (which depends on n); batches then
+        take row slices (which don't)."""
+        if req._x_full is None:
+            H, W = self.model.img_size
+            C = self.model.in_chans
+            if req.x_init is not None:
+                req._x_full = jnp.asarray(req.x_init, jnp.float32)
+            elif req.config.sampler == "cold":
+                color = jax.random.normal(req.key, (req.n, 1, 1, C),
+                                          jnp.float32)
+                req._x_full = jnp.broadcast_to(color, (req.n, H, W, C))
+            else:
+                req._x_full = jax.random.normal(req.key, (req.n, H, W, C),
+                                                jnp.float32)
+        return req._x_full
+
+    def _assemble(self, plan: BatchPlan):
+        """Background-thread H2D stage: build the padded bucket batch on
+        device (init draws dispatch async; guided numpy starts upload here,
+        overlapping the main loop's compute)."""
+        parts = [self._request_init(req)[lo:hi]
+                 for req, lo, hi, _ in plan.entries]
+        if plan.padded_rows:
+            H, W = self.model.img_size
+            parts.append(jnp.zeros((plan.padded_rows, H, W,
+                                    self.model.in_chans), jnp.float32))
+        x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        if self.mesh is not None:
+            x = jax.device_put(x, batch_sharding(self.mesh))
+        return plan, x
+
+    # ------------------------------------------------------------- dispatch
+
+    def _take_cache(self, bucket: int):
+        cache = self._spare_caches.pop(bucket, None)
+        if cache is None:
+            cache = step_cache.init_cache(bucket, self.model.num_patches + 1,
+                                          self.model.embed_dim,
+                                          self.model.dtype)
+            cache = step_cache.shard_cache(cache, self.mesh)
+        return cache
+
+    def _dispatch(self, plan: BatchPlan, x: jax.Array):
+        prog = self.ensure_program(plan.config, plan.bucket)
+        if plan.config.sampler == "cold":
+            if plan.config.cached:
+                out, cache_out = prog(self.params, x,
+                                      self._take_cache(plan.bucket))
+                self._spare_caches[plan.bucket] = cache_out
+            else:
+                out = prog(self.params, x)
+        elif plan.config.cached:
+            out, cache_out = prog(self.params, x, self._key0,
+                                  self._take_cache(plan.bucket))
+            self._spare_caches[plan.bucket] = cache_out
+        else:
+            out = prog(self.params, x, self._key0)
+        self.stats["dispatches"] += 1
+        self.stats["rows"] += plan.rows
+        self.stats["padded_rows"] += plan.padded_rows
+        return out
+
+    def _finish(self, plan: BatchPlan, out) -> None:
+        """D2H + delivery: one blocking fetch per batch, rows copied into
+        each ticket's buffer; padding rows are simply never read."""
+        host = np.asarray(out)
+        for req, lo, hi, offset in plan.entries:
+            if req.ticket._deliver(lo, hi, host[offset:offset + (hi - lo)]):
+                self.stats["latencies_s"].append(req.ticket.latency_s)
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> dict:
+        """Drain the queue: plan → assemble (background) → dispatch → fetch,
+        pipelined. Returns a report for this drain (throughput over real
+        rows — padding is excluded from img/s by construction)."""
+        t0 = time.perf_counter()
+        compiles0 = self.stats["compiles"]
+        rows = padded = batches = 0
+        completed: list[float] = []
+        n_lat0 = len(self.stats["latencies_s"])
+        while True:
+            with self._lock:
+                pending, self._pending = self._pending, []
+            if not pending:
+                break
+            plans = plan_batches(pending, self.buckets)
+            inflight: deque = deque()
+            for plan, x in device_prefetch(plans, lambda p: self._assemble(p),
+                                           depth=self.prefetch_depth):
+                inflight.append((plan, self._dispatch(plan, x)))
+                rows += plan.rows
+                padded += plan.padded_rows
+                batches += 1
+                while len(inflight) > self.inflight:
+                    self._finish(*inflight.popleft())
+            while inflight:
+                self._finish(*inflight.popleft())
+        wall = time.perf_counter() - t0
+        completed = self.stats["latencies_s"][n_lat0:]
+        return {
+            "batches": batches,
+            "rows": rows,
+            "padded_rows": padded,
+            "wall_s": wall,
+            "img_per_sec": rows / wall if wall > 0 else 0.0,
+            "latency": latency_summary(completed),
+            "compiles": self.stats["compiles"] - compiles0,
+            "max_queue_depth": self.stats["max_queue_depth"],
+        }
+
+
+def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig):
+    return sampling._ddim_scan_cached.lower(
+        model, params, x, key, cache, k=config.k, t_start=config.t_start,
+        eta=0.0, cache_interval=config.cache_interval,
+        cache_mode=config.cache_mode, sequence=False).compile()
+
+
+def _cold_cached_lower(model, params, x, cache, config: SamplerConfig):
+    return sampling._cold_scan_cached.lower(
+        model, params, x, cache, levels=config.levels, return_sequence=False,
+        cache_interval=config.cache_interval,
+        cache_mode=config.cache_mode).compile()

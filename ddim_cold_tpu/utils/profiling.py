@@ -15,6 +15,7 @@ ViT.py:222-235). Here the equivalents are structural:
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 
 def trace(log_dir: str):
@@ -42,3 +43,18 @@ def annotate(name: str):
 def enable_nan_checks(enable: bool = True) -> None:
     """Re-run suspect computations de-optimized and raise at NaN origin."""
     jax.config.update("jax_debug_nans", enable)
+
+
+def latency_summary(samples_s) -> dict:
+    """Order statistics over a list of latencies in seconds — the serving
+    engine's per-request report (bench --serving, serve.Engine.stats)."""
+    arr = np.asarray(list(samples_s), dtype=np.float64)
+    if arr.size == 0:
+        return {"n": 0, "p50_s": 0.0, "p95_s": 0.0, "mean_s": 0.0, "max_s": 0.0}
+    return {
+        "n": int(arr.size),
+        "p50_s": float(np.percentile(arr, 50)),
+        "p95_s": float(np.percentile(arr, 95)),
+        "mean_s": float(arr.mean()),
+        "max_s": float(arr.max()),
+    }

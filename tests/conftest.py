@@ -46,7 +46,12 @@ import pytest  # noqa: E402
 # whole pytest process down with SIGSEGV/SIGABRT, losing every result after
 # it. Tests marked `isolated` therefore run in a fresh subprocess: a native
 # crash becomes an ordinary test failure and the rest of the suite survives.
+# The same corruption occasionally DEADLOCKS the child instead of crashing
+# it; the subprocess timeout below exists to turn that wedge into the same
+# ordinary failure before it eats the tier-1 wall budget (ROADMAP's 870 s
+# outer timeout), so it must stay well under budget/2.
 _ISOLATED_CHILD_ENV = "DDIM_COLD_TPU_ISOLATED_CHILD"
+_ISOLATED_TIMEOUT_S = float(os.environ.get("DDIM_COLD_ISOLATED_TIMEOUT_S", "150"))
 
 
 def pytest_configure(config):
@@ -72,14 +77,14 @@ def pytest_runtest_protocol(item, nextitem):
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, env=env,
-            cwd=str(item.config.rootpath), timeout=600,
+            cwd=str(item.config.rootpath), timeout=_ISOLATED_TIMEOUT_S,
         )
         rc = proc.returncode
         out = (proc.stdout or "") + (proc.stderr or "")
     except subprocess.TimeoutExpired as exc:
         rc = -1
         out = ((exc.stdout or b"").decode(errors="replace")
-               + "\nisolated subprocess timed out after 600s")
+               + f"\nisolated subprocess timed out after {_ISOLATED_TIMEOUT_S:g}s")
     duration = time.time() - start
     if rc == 0 and re.search(r"\b1 skipped\b", out) and not re.search(r"\b1 passed\b", out):
         outcome = "skipped"

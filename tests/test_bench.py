@@ -22,6 +22,32 @@ def test_bench_smoke_record(capsys):
     assert np.isfinite(rec["ms_per_step"]) and rec["ms_per_step"] > 0
 
 
+def test_bench_serving_smoke_record(capsys):
+    """The --serving leg must record the serving submetrics the driver
+    compares round over round — sustained img/s, one-shot baseline, latency
+    percentiles, and a zero compiles-after-warmup count (the engine's whole
+    point). Same --batch/--steps as the plain smoke test so the in-process
+    jit caches keep the train half nearly free."""
+    import bench
+
+    bench.main(["--smoke", "--cpu", "--steps", "3", "--batch", "4",
+                "--skip-sampler", "--no-ksweep", "--serving"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    srv = rec["submetrics"]["serving"]
+    assert srv["compiles_after_warmup"] == 0
+    assert srv["warmup"]["new_compiles"] >= 1
+    assert np.isfinite(srv["img_per_sec"]) and srv["img_per_sec"] > 0
+    assert np.isfinite(srv["oneshot_img_per_sec"]) and srv["oneshot_img_per_sec"] > 0
+    # vs_oneshot is recorded for the driver's >= 0.9 acceptance gate; CPU CI
+    # timing is too noisy to assert the ratio itself here
+    assert np.isfinite(srv["vs_oneshot"]) and srv["vs_oneshot"] > 0
+    assert srv["p95_latency_s"] >= srv["p50_latency_s"] > 0
+    assert srv["rows"] > 0 and srv["batches"] > 0
+    assert srv["padded_rows"] == 0  # smoke sizes are built to tile exactly
+    assert srv["max_queue_depth"] >= 1
+
+
 def test_bench_stall_watchdog_emits_partial_record():
     """A wedged RPC mid-run (tunnel drop: the call blocks forever, no
     exception) must still produce a parseable record: the watchdog emits the

@@ -261,3 +261,69 @@ def test_eta_stochastic_sampling(model_and_params):
     with pytest.raises(ValueError, match="pass rng"):
         sampling.ddim_sample(model, params, x_init=np.asarray(det) * 2 - 1,
                              k=500, eta=0.5)
+
+
+def test_last_only_scans_donate_buffers(model_and_params):
+    """The last-only scan entry points donate x_init (and the cached ones the
+    step-cache carry too): the lowered programs must carry input→output
+    aliasing, or the sampler double-buffers x in HBM (the train step has
+    donated since the seed; the samplers promised to in ISSUE 2)."""
+    model, params = model_and_params
+    x = jnp.zeros((2, 16, 16, 3))
+    key = jax.random.PRNGKey(0)
+    plain = sampling._ddim_scan_last.lower(
+        model, params, x, key, k=500, t_start=None, eta=0.0).as_text()
+    assert plain.count("tf.aliasing_output") == 1  # x_init → image
+    from ddim_cold_tpu.ops import step_cache
+    cache = step_cache.init_cache(2, model.num_patches + 1, model.embed_dim,
+                                  model.dtype)
+    cached = sampling._ddim_scan_cached.lower(
+        model, params, x, key, cache, k=500, t_start=None, eta=0.0,
+        cache_interval=2, cache_mode="delta", sequence=False).as_text()
+    assert cached.count("tf.aliasing_output") == 3  # x + both cache halves
+    cold = sampling._cold_scan.lower(
+        model, params, x, levels=4, return_sequence=False).as_text()
+    assert cold.count("tf.aliasing_output") == 1
+    cold_cached = sampling._cold_scan_cached.lower(
+        model, params, x, cache, levels=4, return_sequence=False,
+        cache_interval=2, cache_mode="delta").as_text()
+    assert cold_cached.count("tf.aliasing_output") == 3
+    # the sequence scans must NOT donate — their frames output aliases no
+    # input shape, so donation there would only emit jax's unused-donation
+    # warning and delete a buffer for nothing
+    seq = sampling._ddim_scan_sequence.lower(
+        model, params, x, key, k=500, t_start=None, eta=0.0).as_text()
+    assert "tf.aliasing_output" not in seq
+
+
+def test_donation_consumes_direct_scan_input(model_and_params):
+    """Calling the donated scan directly consumes its x_init buffer (the CPU
+    backend honors donation, so is_deleted is a real check, not a no-op)."""
+    model, params = model_and_params
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 16, 3), jnp.float32)
+    sampling._ddim_scan_last(model, params, x, jax.random.PRNGKey(0),
+                             k=500, t_start=None, eta=0.0)
+    assert x.is_deleted()
+
+
+def test_user_x_init_survives_ddim_sample(model_and_params):
+    """The public API must NOT consume a caller's x_init (tests and the
+    guided apps reuse their encodings): ddim_sample routes caller arrays
+    through a private copy before the donated scan sees them."""
+    model, params = model_and_params
+    x_init = jax.random.normal(jax.random.PRNGKey(11), (2, 16, 16, 3))
+    first = np.asarray(sampling.ddim_sample(model, params, x_init=x_init, k=500))
+    assert not x_init.is_deleted()
+    again = np.asarray(sampling.ddim_sample(model, params, x_init=x_init, k=500))
+    np.testing.assert_array_equal(first, again)
+
+
+def test_init_cache_halves_are_distinct_buffers():
+    """init_cache must return two separate allocations: the cached scans
+    donate the carry, and donating one buffer under two arguments is
+    invalid (jax would reject or double-free)."""
+    from ddim_cold_tpu.ops import step_cache
+
+    a, b = step_cache.init_cache(2, 5, 8, jnp.float32)
+    assert a is not b
+    assert a.unsafe_buffer_pointer() != b.unsafe_buffer_pointer()

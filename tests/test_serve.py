@@ -1,0 +1,299 @@
+"""Serving engine tests: bucket planning edge cases, engine-vs-direct
+BITWISE equality (the ISSUE-2 contract: same request rng, padding rows
+discarded), and the zero-compiles-after-warmup guard.
+
+Bitwise works because every sampler row is computed independently of its
+batchmates; the engine draws each request's init at the request's own n
+(the draw the direct call makes) and only ever slices it. The mesh path is
+allclose, not bitwise — a sharded reduction orders differently (same
+tolerance as the sampler's own mesh tests)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddim_cold_tpu import serve
+from ddim_cold_tpu.models import DiffusionViT
+from ddim_cold_tpu.ops import sampling
+from ddim_cold_tpu.serve.batching import Request, cover_rows, plan_batches, select_bucket
+
+TINY = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
+            num_heads=4, total_steps=2000)
+K = 500  # 4 reverse steps — cheap enough to AOT-compile several programs
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = DiffusionViT(**TINY)
+    x = jnp.zeros((2, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x,
+                        jnp.array([0, 1], jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def warmed(model_and_params):
+    """One engine + warmed plain-DDIM programs at two buckets, shared by the
+    bitwise/packing/stats tests (AOT compiles are the expensive part)."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4, 8))
+    cfg = serve.SamplerConfig(k=K)
+    report = serve.warmup(eng, [cfg], persistent_cache=False)
+    assert report["new_compiles"] == 2  # one program per bucket
+    return eng, cfg
+
+
+def _direct(model, params, seed, n, **kw):
+    return np.asarray(sampling.ddim_sample(
+        model, params, jax.random.PRNGKey(seed), k=K, n=n, **kw))
+
+
+# --------------------------------------------------------------- planning
+
+
+def test_select_bucket():
+    assert select_bucket(1, (8, 32, 128)) == 8
+    assert select_bucket(8, (8, 32, 128)) == 8
+    assert select_bucket(9, (8, 32, 128)) == 32
+    assert select_bucket(129, (8, 32, 128)) is None
+
+
+def test_cover_rows():
+    assert cover_rows(5, (4, 8)) == [8]            # 1 batch beats [4, 4]
+    assert cover_rows(5, (4, 32, 128)) == [4, 4]   # pad 3 beats [32]'s 27
+    assert cover_rows(11, (4, 8)) == [8, 4]
+    assert cover_rows(8, (8,)) == [8]
+    assert cover_rows(260, (8, 32, 128)) == [128, 128, 8]
+    assert cover_rows(1, (8, 32)) == [8]
+    with pytest.raises(ValueError):
+        cover_rows(3, ())
+    with pytest.raises(ValueError):
+        cover_rows(3, (0, 4))
+
+
+def test_plan_batches_empty_queue():
+    assert plan_batches([], (8, 32)) == []
+
+
+def test_plan_batches_packing_offsets_and_split():
+    """A request above the largest bucket splits; offsets tile each batch
+    contiguously and only the last batch of a group carries padding."""
+    cfg = serve.SamplerConfig(k=K)
+    reqs = [Request(config=cfg, n=11), Request(config=cfg, n=3)]
+    plans = plan_batches(reqs, (4, 8))  # 14 rows → [8, 8] (pad 2)
+    assert [p.bucket for p in plans] == [8, 8]
+    assert [p.rows for p in plans] == [8, 6]
+    assert plans[0].padded_rows == 0 and plans[1].padded_rows == 2
+    # request 0's rows 0..8 ride batch 0; rows 8..11 open batch 1, then
+    # request 1's rows 0..3 follow at offset 3
+    assert plans[0].entries == ((reqs[0], 0, 8, 0),)
+    assert plans[1].entries == ((reqs[0], 8, 11, 0), (reqs[1], 0, 3, 3))
+    # every batch is tiled contiguously from offset 0
+    for plan in plans:
+        offset = 0
+        for _, lo, hi, off in plan.entries:
+            assert off == offset
+            offset += hi - lo
+        assert offset == plan.rows
+
+
+def test_plan_batches_mixed_configs_never_share():
+    a = serve.SamplerConfig(k=K)
+    b = serve.SamplerConfig(k=K, cache_interval=2)
+    c = serve.SamplerConfig(sampler="cold")
+    reqs = [Request(config=a, n=2), Request(config=b, n=2),
+            Request(config=a, n=2), Request(config=c, n=2)]
+    plans = plan_batches(reqs, (4, 8))
+    assert len(plans) == 3  # a-group coalesced; b and c alone
+    for plan in plans:
+        assert {e[0].config for e in plan.entries} == {plan.config}
+    a_plan = next(p for p in plans if p.config == a)
+    assert a_plan.rows == 4 and a_plan.bucket == 4  # coalesced, zero pad
+
+
+# ----------------------------------------------------------------- engine
+
+
+def test_engine_bitwise_at_two_buckets(model_and_params, warmed):
+    """The acceptance contract, at both compiled buckets in one drain: mixed
+    request sizes coalesce into a bucket-8 and a bucket-4 batch, and every
+    request comes back bitwise equal to its direct ddim_sample."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    compiles = eng.stats["compiles"]
+    tickets = {seed: eng.submit(seed=seed, n=n, config=cfg)
+               for seed, n in [(21, 5), (22, 4), (23, 3)]}  # 12 rows → [8, 4]
+    report = eng.run()
+    assert report["batches"] == 2 and report["rows"] == 12
+    assert report["padded_rows"] == 0
+    assert eng.stats["compiles"] == compiles  # warmed: zero new programs
+    for seed, n in [(21, 5), (22, 4), (23, 3)]:
+        got = tickets[seed].result(timeout=5)
+        assert got.shape == (n, 16, 16, 3)
+        np.testing.assert_array_equal(got, _direct(model, params, seed, n))
+
+
+def test_engine_bitwise_padded_single_request(model_and_params, warmed):
+    """A lone n=3 request pads to bucket 4; padding rows are discarded and
+    the real rows keep their bits."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    t = eng.submit(seed=31, n=3, config=cfg)
+    report = eng.run()
+    assert report["batches"] == 1 and report["padded_rows"] == 1
+    np.testing.assert_array_equal(t.result(timeout=5),
+                                  _direct(model, params, 31, 3))
+
+
+def test_engine_bitwise_split_request(model_and_params, warmed):
+    """n=11 exceeds the largest bucket (8): the request splits across two
+    batches and reassembles bitwise."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    t = eng.submit(seed=41, n=11, config=cfg)
+    report = eng.run()
+    assert report["batches"] == 2  # [8, 4]
+    np.testing.assert_array_equal(t.result(timeout=5),
+                                  _direct(model, params, 41, 11))
+
+
+def test_engine_bitwise_cached_and_cold(model_and_params):
+    """Cached-sampler and cold-sampler configs serve bitwise too (their
+    scans return the recycled cache; rows must be untouched by that)."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,))
+    cached = serve.SamplerConfig(k=K, cache_interval=2)
+    cold = serve.SamplerConfig(sampler="cold", levels=4)
+    serve.warmup(eng, [cached, cold], persistent_cache=False)
+    compiles = eng.stats["compiles"]
+    tc = eng.submit(seed=51, n=3, config=cached)
+    tk = eng.submit(seed=52, n=2, config=cold)
+    # second cached request: exercises cache-buffer recycling across batches
+    tc2 = eng.submit(seed=53, n=2, config=cached)
+    eng.run()
+    assert eng.stats["compiles"] == compiles
+    np.testing.assert_array_equal(
+        tc.result(timeout=5),
+        _direct(model, params, 51, 3, cache_interval=2))
+    np.testing.assert_array_equal(
+        tc2.result(timeout=5),
+        _direct(model, params, 53, 2, cache_interval=2))
+    np.testing.assert_array_equal(
+        tk.result(timeout=5),
+        np.asarray(sampling.cold_sample(model, params, jax.random.PRNGKey(52),
+                                        n=2, levels=4)))
+
+
+def test_engine_guided_requests_bitwise(model_and_params, warmed):
+    """Guided serving (x_init + t_start — the sample_from path): the host
+    array uploads through the prefetch thread and returns bitwise equal to
+    the direct call."""
+    model, params = model_and_params
+    eng, _ = warmed
+    cfg = serve.SamplerConfig(k=K, t_start=999)
+    enc = np.asarray(jax.random.normal(jax.random.PRNGKey(61), (2, 16, 16, 3)))
+    t = eng.submit(x_init=enc, config=cfg)  # new config: compiles lazily
+    eng.run()
+    want = np.asarray(sampling.sample_from(model, params, jnp.asarray(enc),
+                                           t_start=999, k=K))
+    np.testing.assert_array_equal(t.result(timeout=5), want)
+
+
+def test_zero_compiles_after_warmup_mixed_sizes(model_and_params, warmed):
+    """The compile-count guard: after warmup, a stream of requests at many
+    distinct sizes — across several drains — triggers ZERO program builds
+    (dispatch only ever calls the warmup's AOT executables, which cannot
+    retrace). Complement: an unwarmed engine does compile, so the counter
+    is live, not trivially zero."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    compiles = eng.stats["compiles"]
+    for batch_sizes in ([1, 2], [3, 5, 7], [11], [4, 8, 6]):
+        tickets = [eng.submit(seed=70 + n, n=n, config=cfg)
+                   for n in batch_sizes]
+        eng.run()
+        for t in tickets:
+            assert t.done
+    assert eng.stats["compiles"] == compiles
+
+    fresh = serve.Engine(model, params, buckets=(4,))
+    t = fresh.submit(seed=1, n=2, config=cfg)
+    fresh.run()
+    assert fresh.stats["compiles"] > 0  # lazy compile happened and was counted
+    assert t.done
+
+
+def test_engine_stats_and_latency(model_and_params, warmed):
+    eng, cfg = warmed
+    n_before = len(eng.stats["latencies_s"])
+    t = eng.submit(seed=81, n=2, config=cfg)
+    assert eng.queue_depth() == 1
+    report = eng.run()
+    assert eng.queue_depth() == 0
+    assert t.latency_s is not None and t.latency_s > 0
+    assert len(eng.stats["latencies_s"]) == n_before + 1
+    lat = report["latency"]
+    assert lat["n"] == 1 and lat["p95_s"] >= lat["p50_s"] > 0
+    assert report["img_per_sec"] > 0
+    assert eng.stats["max_queue_depth"] >= 1
+
+
+def test_engine_validation_and_ticket_timeout(model_and_params):
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,))
+    with pytest.raises(ValueError, match="seed= or rng="):
+        eng.submit(n=2)
+    with pytest.raises(ValueError, match="not both"):
+        eng.submit(seed=0, n=2, config=serve.SamplerConfig(), k=10)
+    with pytest.raises(ValueError, match="DDIM path"):
+        eng.submit(x_init=np.zeros((1, 16, 16, 3)), sampler="cold")
+    with pytest.raises(ValueError, match="n must be"):
+        eng.submit(seed=0, n=0)
+    with pytest.raises(ValueError, match="sampler must be"):
+        serve.SamplerConfig(sampler="euler")
+    with pytest.raises(ValueError, match="cache_mode"):
+        serve.SamplerConfig(cache_mode="none")
+    with pytest.raises(ValueError, match="buckets"):
+        serve.Engine(model, params, buckets=())
+    ticket = eng.submit(seed=0, n=2)
+    with pytest.raises(TimeoutError, match="Engine.run"):
+        ticket.result(timeout=0.01)  # never ran — must not hang forever
+
+
+def test_engine_mesh_sharded(model_and_params):
+    """Sharded serving: buckets must divide the data axis, and the sharded
+    drain reproduces the single-device result within the sampler's own
+    SPMD tolerance (bitwise is a per-backend contract, not cross-mesh)."""
+    from ddim_cold_tpu.parallel.mesh import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh({"data": 8})
+    with pytest.raises(ValueError, match="divide"):
+        serve.Engine(model, params, mesh=mesh, buckets=(4, 8))
+    eng = serve.Engine(model, params, mesh=mesh, buckets=(8,))
+    cfg = serve.SamplerConfig(k=K)
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    compiles = eng.stats["compiles"]
+    t = eng.submit(seed=91, n=8, config=cfg)
+    eng.run()
+    assert eng.stats["compiles"] == compiles
+    np.testing.assert_allclose(t.result(timeout=5),
+                               _direct(model, params, 91, 8),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_check_compile_cache_script():
+    """The scripts/ CI check passes (or capability-skips) on the running
+    jax — rc 0 either way; rc 1 means the persistent cache wiring broke."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "check_compile_cache.py")],
+        capture_output=True, text=True, timeout=300, cwd=root,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ("PASS" in proc.stdout) or ("SKIP" in proc.stdout), proc.stdout
