@@ -169,6 +169,15 @@ def main(argv=None):
                          "p50/p95 request latency, queue depth and "
                          "compiles-after-warmup; composes with --smoke for "
                          "a CPU-budget run")
+    ap.add_argument("--quant", action="store_true",
+                    help="run the w8a16 quantized-inference legs "
+                         "(ops/quant.py): 64px sampler in both dequant-matmul "
+                         "modes with paired pixel drift + param-byte savings, "
+                         "a quantized serving drain when --serving is also "
+                         "set, and the 200px "
+                         "sampler_throughput_200px_k20_flash_w8a16 leg when "
+                         "the north-star section runs; composes with --smoke "
+                         "for a CPU-budget run")
     ap.add_argument("--xla-blockwise", action="store_true",
                     help="also time the pure-XLA blockwise attention leg in "
                          "the north-star section (retired from the default "
@@ -235,9 +244,12 @@ def main(argv=None):
         args.ksweep = not args.smoke  # an explicit flag wins either way
 
     from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
+    from ddim_cold_tpu.ops.quant import QUANT_REV
     from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
-    sub = {"kernel_rev": KERNEL_REV}
+    # both revision stamps ride every record (quant_rev mirrors kernel_rev:
+    # stale-record protection keys re-measurement off them)
+    sub = {"kernel_rev": KERNEL_REV, "quant_rev": QUANT_REV}
     # The record is assembled INCREMENTALLY and the watchdog below can emit it
     # mid-run: on the remote-TPU tunnel a dropped connection leaves the next
     # XLA RPC blocked forever with no exception to catch (observed r03:
@@ -647,9 +659,75 @@ def main(argv=None):
                 f"img/s at n={bmax} → ratio "
                 f"{sub['serving']['vs_oneshot']}; compiles after warmup: "
                 f"{best['compiles']}")
+            if args.quant:
+                # w8a16 serving: warm the quant programs (same zero-compiles
+                # guard), drain the same mixed stream at quant config, and
+                # record the int8 param-byte footprint the engine ships once
+                cfg_q = serve.SamplerConfig(k=k_serve, quant="xla")
+                mark("serving quant warmup", budget_s=2 * stall_s)
+                wu_q = serve.warmup(engine, [cfg_q])
+                best_q = None
+                for rep in range(2):
+                    mark(f"serving quant drain rep {rep}")
+                    for i, n_req in enumerate(sizes):
+                        engine.submit(seed=200 + i, n=n_req, config=cfg_q)
+                    rq = engine.run()
+                    if best_q is None or rq["img_per_sec"] > best_q["img_per_sec"]:
+                        best_q = rq
+                sub["serving"]["quant"] = {
+                    "img_per_sec": round(best_q["img_per_sec"], 2),
+                    "vs_float_serving": round(
+                        best_q["img_per_sec"] / best["img_per_sec"], 3),
+                    "compiles_after_warmup": best_q["compiles"],
+                    "warmup_new_compiles": wu_q["new_compiles"],
+                    "param_bytes": engine.stats["param_bytes"],
+                    "param_bytes_quant": engine.stats["param_bytes_quant"],
+                }
+                log(f"serving w8a16: {best_q['img_per_sec']:.2f} img/s "
+                    f"(float {best['img_per_sec']:.2f}); param bytes "
+                    f"{engine.stats['param_bytes']} → "
+                    f"{engine.stats['param_bytes_quant']}; compiles after "
+                    f"warmup: {best_q['compiles']}")
 
         if args.serving:
             section("serving", run_serving)
+
+        def run_quant64():
+            # w8a16 sampler legs at 64px (ops/quant.py), both dequant-matmul
+            # modes against the float model's memoized timing: throughput,
+            # paired same-rng pixel drift, and the param-byte saving the
+            # serving engine banks on. Under --smoke the stride drops to the
+            # serving leg's k=400 (5 reverse steps) so the CPU interpret-mode
+            # Pallas leg stays inside the tier-1 budget.
+            from ddim_cold_tpu.ops import quant as quant_mod
+            from ddim_cold_tpu.ops import sampling
+
+            k_q = 400 if args.smoke else 20
+            qp = quant_mod.quantize_params(state.params)
+            float_t = time_ddim(model, state.params, k_q, n_sample,
+                                "64px float")
+            img_f = np.asarray(sampling.ddim_sample(
+                model, state.params, jax.random.PRNGKey(5), k=k_q, n=n_sample))
+            modes = {}
+            for mode in ("xla", "pallas"):
+                qm = model.clone(quant=mode)
+                sdt = time_ddim(qm, qp, k_q, n_sample, f"64px w8a16-{mode}")
+                img_q = np.asarray(sampling.ddim_sample(
+                    qm, qp, jax.random.PRNGKey(5), k=k_q, n=n_sample))
+                modes[mode] = {
+                    "img_per_sec": round(n_sample / sdt, 2),
+                    "speedup_vs_float": round(float_t / sdt, 3),
+                    "max_abs_pixel_delta": round(
+                        float(np.max(np.abs(img_q - img_f))), 6)}
+            sub["sampler_64px_w8a16"] = {
+                "k": k_q, "n": n_sample,
+                "float_img_per_sec": round(n_sample / float_t, 2),
+                "param_bytes": quant_mod.param_bytes(state.params),
+                "param_bytes_quant": quant_mod.param_bytes(qp),
+                "modes": modes}
+
+        if args.quant:
+            section("quant_64px", run_quant64)
 
         # 200px north-star state, shared across run_northstar, the cached
         # legs and run_northstar_profile: the 200px param init is one of the
@@ -805,6 +883,63 @@ def main(argv=None):
         if not args.skip_northstar:
             section("northstar_cached", run_northstar_cached)
 
+        def run_northstar_quant():
+            # the w8a16 tentpole leg, armed for chip: the flash sampler over
+            # int8 trunk weights at the north-star shape. Headline = the
+            # faster dequant-matmul mode (the fused Pallas kernel vs the
+            # XLA epilogue form — which wins on a real MXU is exactly what
+            # this leg exists to measure); speedup is against the bf16 flash
+            # leg's memoized timing, drift is the paired same-rng pixel
+            # delta, and the param-byte line is the ≈4× H2D saving.
+            from ddim_cold_tpu.ops import quant as quant_mod
+            from ddim_cold_tpu.ops import sampling
+
+            n, k = 16, 20
+            cm = ns_flash_model()
+            cp = ns_params_for(cm)
+            qp = quant_mod.quantize_params(cp)
+            exact_t = time_ddim(cm, cp, k, n, "north-star 200px flash")
+            img_exact = np.asarray(sampling.ddim_sample(
+                cm, cp, jax.random.PRNGKey(5), k=k, n=n))
+            modes = {}
+            for mode in ("pallas", "xla"):
+                qm = cm.clone(quant=mode)
+                try:
+                    sdt = time_ddim(qm, qp, k, n, f"north-star w8a16-{mode}")
+                except Exception as e:  # noqa: BLE001 — a Mosaic rejection
+                    # of the fused kernel must not cost the XLA leg
+                    modes[mode] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                    continue
+                img_q = np.asarray(sampling.ddim_sample(
+                    qm, qp, jax.random.PRNGKey(5), k=k, n=n))
+                modes[mode] = {
+                    "img_per_sec": round(n / sdt, 2),
+                    "speedup_vs_bf16_flash": round(exact_t / sdt, 3),
+                    "max_abs_pixel_delta": round(
+                        float(np.max(np.abs(img_q - img_exact))), 6)}
+            ok = [m for m in modes.values() if "img_per_sec" in m]
+            if ok:
+                headline = max(ok, key=lambda m: m["img_per_sec"])
+                f = flops_util.vit_trunk_gemm_fraction(
+                    img_size=(200, 200), patch_size=4,
+                    **{kk: MODEL_CONFIGS["oxford_flower_200_p4"][kk]
+                       for kk in ("embed_dim", "depth", "num_heads")})
+                sub["sampler_throughput_200px_k20_flash_w8a16"] = {
+                    "value": headline["img_per_sec"], "unit": "img/s/chip",
+                    "n": n, "k": k,
+                    "speedup_vs_bf16_flash": headline["speedup_vs_bf16_flash"],
+                    "max_abs_pixel_delta": headline["max_abs_pixel_delta"],
+                    "param_bytes": quant_mod.param_bytes(cp),
+                    "param_bytes_quant": quant_mod.param_bytes(qp),
+                    "trunk_gemm_fraction": round(f, 4),
+                    "mixed_peak_tflops": flops_util.mixed_peak_tflops(chip, f),
+                    "modes": modes}
+            else:
+                sub["northstar_w8a16_error"] = modes
+
+        if args.quant and not args.skip_northstar:
+            section("northstar_quant", run_northstar_quant)
+
         def run_cached_quality():
             # distributional guard for the step cache at 64px (chip-cheap;
             # the 200px legs above carry the pixel-delta guard): Fréchet
@@ -822,6 +957,27 @@ def main(argv=None):
 
         if not args.skip_sampler:
             section("cached_quality", run_cached_quality, retries=0)
+
+        def run_quant_quality():
+            # paired Fréchet guard for the w8a16 trunk (same contract as the
+            # step-cache guard above), plus the COMPOSED quant × step-cache
+            # row the PERF.md composition table reports
+            from ddim_cold_tpu.eval import fid as fid_mod
+
+            n_q = 32 if args.smoke else 256
+            k_q = 400 if args.smoke else 20
+            sub["quant_quality_64px"] = fid_mod.quantized_sampler_guard(
+                model, state.params, rng=jax.random.PRNGKey(19),
+                n_samples=n_q, sample_batch=min(n_q, 64), k=k_q)
+            log(f"quant quality 64px: {sub['quant_quality_64px']}")
+            sub["quant_cached_quality_64px"] = fid_mod.quantized_sampler_guard(
+                model, state.params, rng=jax.random.PRNGKey(19),
+                n_samples=n_q, sample_batch=min(n_q, 64), k=k_q,
+                cache_interval=2, cache_mode="full")
+            log(f"quant×cache quality 64px: {sub['quant_cached_quality_64px']}")
+
+        if args.quant and not args.skip_sampler:
+            section("quant_quality", run_quant_quality, retries=0)
 
         def run_northstar_profile():
             # one traced tuned-blocks flash sampling run (n=16, k=20): the

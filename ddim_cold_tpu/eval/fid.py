@@ -265,3 +265,77 @@ def cached_sampler_guard(
                       "extractor — distance is meaningful, absolute FID "
                       "scale is not)"),
     }
+
+
+def quantized_sampler_guard(
+    model,
+    params,
+    *,
+    rng: jax.Array,
+    n_samples: int = 256,
+    sample_batch: int = 64,
+    k: int = 20,
+    quant: str = "xla",
+    cache_interval: int = 1,
+    cache_mode: str = "full",
+    quantized_params=None,
+    inception_model=None,
+    inception_variables=None,
+) -> dict:
+    """Quality guard for the w8a16 trunk (ops/quant.py), the exact shape of
+    :func:`cached_sampler_guard`: the Fréchet distance between the EXACT
+    float and the QUANTIZED samplers' output streams from the SAME rng
+    sequence under one extractor — 0 when quantization is harmless, and the
+    acceptance bound ("shift ≤ 0.5") reads directly off it.
+
+    ``model/params`` are the float pair; the quantized side runs
+    ``model.clone(quant=quant)`` over ``quant.quantize_params(params)``
+    (pass ``quantized_params`` to reuse a tree built elsewhere, e.g. the
+    serving engine's). ``cache_interval`` > 1 additionally routes the
+    quantized stream through the step cache, measuring the COMPOSED shift
+    (quantization × block reuse) the PERF.md composition table reports.
+    Alongside the distance, ``quant.calibrate``'s per-layer max-abs-error
+    stats ride the report so a bad distance is attributable to a layer.
+    """
+    from ddim_cold_tpu.ops import quant as quant_mod
+    from ddim_cold_tpu.ops import sampling
+
+    qmodel = model.clone(quant=quant)
+    qparams = (quantized_params if quantized_params is not None
+               else quant_mod.quantize_params(params))
+    feature_fn, dim = make_feature_fn(inception_model, inception_variables)
+    exact, quantized = ActivationStats(dim), ActivationStats(dim)
+    max_delta = 0.0
+    remaining = n_samples
+    while remaining > 0:
+        keep = min(sample_batch, remaining)
+        rng, sub = jax.random.split(rng)
+        imgs_e = sampling.ddim_sample(model, params, sub, k=k, n=sample_batch)
+        imgs_q = sampling.ddim_sample(qmodel, qparams, sub, k=k,
+                                      n=sample_batch,
+                                      cache_interval=cache_interval,
+                                      cache_mode=cache_mode)
+        max_delta = max(max_delta, float(jnp.max(jnp.abs(imgs_e - imgs_q))))
+        exact.update(np.asarray(feature_fn(imgs_e))[:keep])
+        quantized.update(np.asarray(feature_fn(imgs_q))[:keep])
+        remaining -= keep
+    cal = quant_mod.calibrate(params)
+    worst = (max(cal.items(), key=lambda kv: kv[1]["max_abs_err"])
+             if cal else (None, None))
+    return {
+        "fid_exact_vs_quant": round(float(fid_from_stats(exact, quantized)), 4),
+        "max_abs_pixel_delta": round(max_delta, 6),
+        "n_samples": n_samples,
+        "k": k,
+        "quant": quant,
+        "quant_rev": quant_mod.QUANT_REV,
+        "cache_interval": cache_interval,
+        "cache_mode": cache_mode,
+        "calibration_worst_layer": worst[0],
+        "calibration_max_abs_err": (None if worst[1] is None
+                                    else round(worst[1]["max_abs_err"], 8)),
+        "extractor": ("canonical" if inception_variables is not None else
+                      "seeded random-init proxy (paired streams, same "
+                      "extractor — distance is meaningful, absolute FID "
+                      "scale is not)"),
+    }

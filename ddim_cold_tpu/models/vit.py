@@ -83,16 +83,23 @@ class Mlp(nn.Module):
     out_features: int
     drop: float = 0.0
     dtype: Dtype = jnp.float32
+    quant: Optional[str] = None  # None | "xla" | "pallas" (ops/quant.py w8a16)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
-        dense = lambda feat, name: nn.Dense(
-            feat,
-            dtype=self.dtype,
-            kernel_init=trunc_normal(std=0.02),
-            bias_init=nn.initializers.zeros_init(),
-            name=name,
-        )
+        if self.quant:
+            from ddim_cold_tpu.ops.quant import QuantDense
+
+            dense = lambda feat, name: QuantDense(
+                feat, dtype=self.dtype, mode=self.quant, name=name)
+        else:
+            dense = lambda feat, name: nn.Dense(
+                feat,
+                dtype=self.dtype,
+                kernel_init=trunc_normal(std=0.02),
+                bias_init=nn.initializers.zeros_init(),
+                name=name,
+            )
         x = dense(self.hidden_features, "fc1")(x)
         x = nn.gelu(x, approximate=False)
         x = nn.Dropout(self.drop, deterministic=deterministic)(x)
@@ -153,6 +160,7 @@ class Attention(nn.Module):
     seq_manual: bool = False
     seq_valid_len: Optional[int] = None
     seq_varying_axes: Optional[tuple] = None
+    quant: Optional[str] = None  # w8a16 qkv/proj kernels (ops/quant.py)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -161,14 +169,22 @@ class Attention(nn.Module):
         head_dim = C // self.num_heads
         scale = self.qk_scale or head_dim**-0.5
 
-        qkv = nn.Dense(
-            3 * self.dim,
-            use_bias=self.qkv_bias,
-            dtype=self.dtype,
-            kernel_init=trunc_normal(std=0.02),
-            bias_init=nn.initializers.zeros_init(),
-            name="qkv",
-        )(x)
+        if self.quant:
+            from ddim_cold_tpu.ops.quant import QuantDense
+
+            dense = lambda feat, use_bias, name: QuantDense(
+                feat, use_bias=use_bias, dtype=self.dtype, mode=self.quant,
+                name=name)
+        else:
+            dense = lambda feat, use_bias, name: nn.Dense(
+                feat,
+                use_bias=use_bias,
+                dtype=self.dtype,
+                kernel_init=trunc_normal(std=0.02),
+                bias_init=nn.initializers.zeros_init(),
+                name=name,
+            )
+        qkv = dense(3 * self.dim, self.qkv_bias, "qkv")(x)
         # unpack order (3, heads, head_dim) matches the torch reshape
         # (B,N,3,H,hd) so converted checkpoints line up slice-for-slice.
         qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
@@ -275,13 +291,7 @@ class Attention(nn.Module):
             out = jnp.einsum("bhnm,bmhd->bnhd", attn, v)
 
         out = out.reshape(B, N, C)
-        out = nn.Dense(
-            self.dim,
-            dtype=self.dtype,
-            kernel_init=trunc_normal(std=0.02),
-            bias_init=nn.initializers.zeros_init(),
-            name="proj",
-        )(out)
+        out = dense(self.dim, True, "proj")(out)
         out = nn.Dropout(self.proj_drop, deterministic=deterministic)(out)
         return out, attn
 
@@ -312,11 +322,16 @@ class Block(nn.Module):
     num_experts: int = 1  # >1: Switch-MoE MLP (models/moe.py, 'expert' axis)
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # routing impl: "einsum" | "index" (moe.py)
+    quant: Optional[str] = None  # w8a16 trunk denses (ops/quant.py)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
                  return_attention: bool = False,
                  dp_rate: Optional[jax.Array] = None):
+        if self.quant and self.num_experts > 1:
+            raise ValueError(
+                "quant covers the dense trunk only — the Switch-MoE expert "
+                "banks have no quantized path (set num_experts=1)")
         ln = lambda name: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name=name)
         y, attn = Attention(
             dim=self.dim,
@@ -336,6 +351,7 @@ class Block(nn.Module):
             seq_manual=self.seq_manual,
             seq_valid_len=self.seq_valid_len,
             seq_varying_axes=self.seq_varying_axes,
+            quant=self.quant,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -379,6 +395,7 @@ class Block(nn.Module):
                 out_features=self.dim,
                 drop=self.drop,
                 dtype=self.dtype,
+                quant=self.quant,
                 name="mlp",
             )
         y = mlp(ln("norm2")(x), deterministic=deterministic)
@@ -511,6 +528,11 @@ class DiffusionViT(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # see models/moe.py: "index" removes the
     # O(N^2*cf) one-hot dispatch tensors (long-sequence configs)
+    quant: Optional[str] = None  # w8a16 trunk inference (ops/quant.py):
+    # None = float kernels (the training path, bit-identical to before);
+    # "xla" | "pallas" = per-output-channel int8 qkv/proj/fc1/fc2 consumed
+    # from a quantize_params tree; embeddings/norms/patch/head stay float.
+    # Part of the module hash, so jit/AOT program caches key on it.
 
     @property
     def num_patches(self) -> int:
@@ -557,6 +579,17 @@ class DiffusionViT(nn.Module):
         mutually exclusive with each other, with ``scan_blocks`` (one scanned
         body cannot statically drop layers), with the attention probe, and
         with partial ``stage`` forwards."""
+        if self.quant is not None:
+            from ddim_cold_tpu.ops.quant import QUANT_MODES
+
+            if self.quant not in QUANT_MODES:
+                raise ValueError(f"quant must be None or one of {QUANT_MODES}, "
+                                 f"got {self.quant!r}")
+            if self.scan_blocks:
+                # the stacked (depth, in, out) kernel layout would need a
+                # per-layer scale axis the codec doesn't model; quant serves
+                # the unrolled inference path (which the samplers use)
+                raise ValueError("quant requires scan_blocks=False")
         if skip_blocks is not None or capture_split is not None:
             if self.scan_blocks:
                 raise ValueError(
@@ -701,6 +734,7 @@ class DiffusionViT(nn.Module):
                     num_experts=self.num_experts,
                     moe_capacity_factor=self.moe_capacity_factor,
                     moe_dispatch=self.moe_dispatch,
+                    quant=self.quant,
                 )
                 probe = (return_attention_layer is not None
                          and i == return_attention_layer % self.depth)

@@ -1,0 +1,349 @@
+"""W8A16 post-training quantization for the ViT trunk (the param-traffic lever).
+
+PERF.md's north-star analysis puts the 200px/k=20 sampler past the
+attention-HBM wall (flash kernel); the next costs are trunk GEMM time and
+parameter bytes over the link. Training-free weight-only quantization is the
+standard diffusion-transformer answer (Efficient Diffusion Models survey,
+arXiv:2502.06805): **symmetric per-output-channel int8 weights, bf16
+activations** (w8a16) for the four trunk GEMMs per block — attention
+``qkv``/``proj`` and Mlp ``fc1``/``fc2``. Embeddings, layernorms, the patch
+projection and the output head stay in float (small, and the head sets pixel
+accuracy).
+
+Pieces:
+
+* ``quantize_weight`` / ``dequantize_weight`` — the per-output-channel
+  symmetric codec: ``scale = max|w|/127`` per output column, values clipped
+  to [−127, 127] (the −128 code is unused, keeping the codec symmetric).
+* ``quantize_params`` — one-shot transform of a DiffusionViT param tree:
+  each trunk dense's ``kernel`` leaf becomes ``{w_int8, scale}`` IN PLACE
+  (same module paths, bias untouched), so ``parallel/sharding.py``'s
+  module-name keyed specs and the serving engine's pre-sharded param flow
+  apply unchanged, and the tree ships ≈4× fewer trunk-param bytes.
+* ``dequant_matmul`` — the w8a16 GEMM, two implementations behind one
+  signature:
+
+  - ``mode="xla"``: ``lax.dot_general`` on the int8 weights upcast to the
+    activation dtype with ``preferred_element_type=f32`` accumulation; XLA
+    fuses the int8→bf16 convert into the matmul read and the per-column
+    scale multiply into the epilogue — no dequantized weight copy in HBM.
+  - ``mode="pallas"``: a fused dequant-matmul kernel (grid over M/N tiles,
+    K streamed innermost through a VMEM f32 accumulator, scale applied once
+    at emit). Same capability gating as ops/flash_attention.py: TPU runs
+    the kernel, CPU runs it in interpreter mode (tests exercise the real
+    code path), any other backend falls back to the XLA form.
+
+* ``QuantDense`` — the flax module models/vit.py swaps in for ``nn.Dense``
+  when ``model.quant`` is set; declares exactly the ``{w_int8, scale[, bias]}``
+  leaves ``quantize_params`` produces.
+* ``calibrate`` — per-layer max-abs quantization error stats, so a bad layer
+  in the paired Fréchet guard (eval/fid.quantized_sampler_guard) is
+  attributable to its scale, not hunted by bisection.
+
+Both matmul paths accumulate in f32 and apply scale/bias in f32, so
+``mode="xla"`` and ``mode="pallas"`` agree to f32 round-off and either can
+stand in for the other in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: Pallas-TPU compiler params across jax versions (same shim as
+#: ops/flash_attention.py — renamed TPUCompilerParams → CompilerParams)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+#: quantization revision stamped into bench records (mirrors KERNEL_REV:
+#: scripts/perf_tables.py renders it and stale-record protection keys
+#: re-measurement off it). "w8a16-pcq-v1" = per-output-channel symmetric
+#: int8 weights, [−127, 127] codes, f32-accumulated dequant matmul.
+QUANT_REV = "w8a16-pcq-v1"
+
+#: dequant_matmul modes a model/SamplerConfig may request
+QUANT_MODES = ("xla", "pallas")
+
+#: trunk modules whose ``kernel`` is quantized, keyed by parent module name —
+#: the same (parent, leaf) addressing parallel/sharding.py's _spec_for uses.
+#: NOTE ``proj`` alone is ambiguous (patch_embed's dense is also "proj");
+#: the parent-name key is what keeps the patch projection in float.
+_TRUNK_DENSE = {"attn": ("qkv", "proj"), "mlp": ("fc1", "fc2")}
+
+_LANE = 128  # TPU lane width: last dim of VMEM tiles
+_INT8_SUBLANE = 32  # int8 min tile is (32, 128): K blocks must be 32-aligned
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def quantize_weight(kernel: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a (in, out) kernel.
+
+    ``scale[j] = max_i |kernel[i, j]| / 127`` (1.0 for all-zero columns so
+    dequantization never divides by zero); codes are round-to-nearest-even
+    and clipped to [−127, 127]. Round-trip error is ≤ scale/2 per channel by
+    construction (asserted in tests/test_quant.py).
+    """
+    k32 = jnp.asarray(kernel, jnp.float32)
+    amax = jnp.max(jnp.abs(k32), axis=tuple(range(k32.ndim - 1)))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(k32 / scale), -127.0, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_weight(w_int8: jax.Array, scale: jax.Array,
+                      dtype: Any = jnp.float32) -> jax.Array:
+    return (w_int8.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# param-tree transform
+# ---------------------------------------------------------------------------
+
+def _is_trunk_dense(path: tuple[str, ...]) -> bool:
+    return (len(path) >= 2 and path[-1] in _TRUNK_DENSE.get(path[-2], ()))
+
+
+def _walk(tree, path=()):
+    """Yield ``(path, module_dict)`` for every trunk dense holding a kernel."""
+    if not isinstance(tree, dict) and not hasattr(tree, "items"):
+        return
+    for name, sub in tree.items():
+        sub_path = path + (name,)
+        if _is_trunk_dense(sub_path) and hasattr(sub, "items") and "kernel" in sub:
+            yield sub_path, sub
+        else:
+            yield from _walk(sub, sub_path)
+
+
+def quantize_params(params):
+    """One-shot w8a16 transform of a DiffusionViT ``params`` tree.
+
+    Every trunk dense (``attn/{qkv,proj}``, ``mlp/{fc1,fc2}``) has its
+    ``kernel`` replaced by ``{w_int8, scale}``; biases and every non-trunk
+    leaf pass through untouched. The tree topology (module paths) is
+    preserved, so partition-spec derivation and the engine's param flow see
+    the same structure. The result is what ``model.clone(quant=...)``'s
+    forward consumes (models/vit.py routes the trunk through
+    :class:`QuantDense`).
+    """
+    def rec(tree, path=()):
+        if not hasattr(tree, "items"):
+            return tree
+        out = {}
+        for name, sub in tree.items():
+            sub_path = path + (name,)
+            if (_is_trunk_dense(sub_path) and hasattr(sub, "items")
+                    and "kernel" in sub):
+                w_int8, scale = quantize_weight(sub["kernel"])
+                mod = {k: v for k, v in sub.items() if k != "kernel"}
+                mod["w_int8"], mod["scale"] = w_int8, scale
+                out[name] = mod
+            else:
+                out[name] = rec(sub, sub_path)
+        return out
+
+    return rec(params)
+
+
+def is_quantized(params) -> bool:
+    """True when the tree carries at least one ``w_int8`` trunk leaf."""
+    found = []
+
+    def rec(tree):
+        if hasattr(tree, "items"):
+            for name, sub in tree.items():
+                if name == "w_int8":
+                    found.append(True)
+                rec(sub)
+
+    rec(params)
+    return bool(found)
+
+
+def param_bytes(params) -> int:
+    """Total bytes of every array leaf — the H2D param-traffic number the
+    serving engine reports (int8 trunks ship ≈4× fewer)."""
+    return int(sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree_util.tree_leaves(params)))
+
+
+def calibrate(params) -> dict:
+    """Per-layer quantization error stats: for every trunk dense, the
+    worst-case absolute weight error, the worst error relative to the
+    channel's own scale (≤ 0.5 by construction — a larger value means the
+    codec is broken for that layer) and the scale range. Keys are
+    '/'-joined module paths, so a bad layer in the paired Fréchet guard is
+    attributable by name."""
+    stats = {}
+    for path, mod in _walk(params):
+        w_int8, scale = quantize_weight(mod["kernel"])
+        err = jnp.abs(jnp.asarray(mod["kernel"], jnp.float32)
+                      - w_int8.astype(jnp.float32) * scale)
+        stats["/".join(path)] = {
+            "max_abs_err": float(jnp.max(err)),
+            "max_err_over_scale": float(jnp.max(err / scale)),
+            "scale_min": float(jnp.min(scale)),
+            "scale_max": float(jnp.max(scale)),
+            "shape": tuple(int(d) for d in mod["kernel"].shape),
+        }
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# w8a16 matmul — XLA path
+# ---------------------------------------------------------------------------
+
+def _dequant_matmul_xla(x: jax.Array, w_int8: jax.Array,
+                        scale: jax.Array) -> jax.Array:
+    """``x @ (w_int8 * scale)`` without materializing the dequantized weight:
+    the int8→activation-dtype convert fuses into the matmul operand read and
+    the per-column scale into the f32 epilogue. Accumulation is f32
+    (``preferred_element_type``), the w8a16 contract."""
+    w = w_int8.astype(x.dtype)
+    y = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return y * scale
+
+
+# ---------------------------------------------------------------------------
+# w8a16 matmul — Pallas fused kernel
+# ---------------------------------------------------------------------------
+
+def _use_kernel() -> bool:
+    # same policy as ops/flash_attention.py: TPU compiles the kernel, CPU
+    # interprets it (tests exercise the identical code path), any other
+    # backend (GPU) takes the XLA form instead of a silent interpreter crawl
+    return jax.default_backend() in ("tpu", "cpu")
+
+
+def _mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int):
+    """One (m-tile, n-tile, k-chunk) program: dequantize this int8 weight
+    chunk to the activation dtype in VMEM, fold its partial product into the
+    f32 accumulator, and on the last chunk apply the per-column scale once
+    and emit. K is the innermost (sequential) grid axis, so the scratch
+    accumulator carries across chunks of one output tile."""
+    k_i = pl.program_id(2)
+
+    @pl.when(k_i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                      # (bm, bk) activation dtype
+    w = w_ref[...].astype(x.dtype)      # (bk, bn) int8 → activation dtype
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(k_i == n_k - 1)
+    def _emit():
+        o_ref[...] = acc_ref[...] * s_ref[0]
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _pad_axis(x: jax.Array, axis: int, to: int) -> jax.Array:
+    pad = to - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "block_k"))
+def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
+                           *, block_m: int = 256, block_n: int = 512,
+                           block_k: int = 512) -> jax.Array:
+    """Fused dequant-matmul on a 2-D ``(M, K) @ (K, N)`` problem.
+
+    Tiling honors the TPU tile rules: K blocks are lane-width (128) aligned
+    (covering the int8 (32, 128) min tile on the weight's sublane dim), N
+    blocks lane-aligned, M blocks sublane (8) aligned. Zero-padding is
+    inert — padded K rows of the weight contribute zero partial products,
+    padded M/N rows/columns are sliced off the output.
+    """
+    M, K = x2d.shape
+    _, N = w_int8.shape
+    bm = min(block_m, _round_up(M, 8))
+    bn = min(block_n, _round_up(N, _LANE))
+    bk = min(block_k, _round_up(K, _LANE))
+    xp = _pad_axis(_pad_axis(x2d, 0, _round_up(M, bm)), 1, _round_up(K, bk))
+    wp = _pad_axis(_pad_axis(w_int8, 0, _round_up(K, bk)), 1, _round_up(N, bn))
+    sp = _pad_axis(scale.astype(jnp.float32)[None, :], 1, _round_up(N, bn))
+    n_k = xp.shape[1] // bk
+
+    out = pl.pallas_call(
+        functools.partial(_mm_kernel, n_k=n_k),
+        grid=(xp.shape[0] // bm, wp.shape[1] // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(xp, wp, sp)
+    return out[:M, :N]
+
+
+# ---------------------------------------------------------------------------
+# public matmul entry
+# ---------------------------------------------------------------------------
+
+def dequant_matmul(x: jax.Array, w_int8: jax.Array, scale: jax.Array,
+                   *, mode: str = "xla") -> jax.Array:
+    """w8a16 matmul over the last axis of ``x``: ``x @ (w_int8·scale)`` with
+    f32 accumulation; returns f32 (callers add bias in f32 and cast to the
+    compute dtype — one epilogue for both modes). ``mode="pallas"`` runs the
+    fused kernel where capability allows and silently takes the XLA form
+    elsewhere, exactly the flash-attention fallback policy."""
+    if mode not in QUANT_MODES:
+        raise ValueError(f"quant mode must be one of {QUANT_MODES}, got {mode!r}")
+    if w_int8.dtype != jnp.int8:
+        raise ValueError(f"w_int8 must be int8, got {w_int8.dtype}")
+    if mode == "pallas" and _use_kernel():
+        lead = x.shape[:-1]
+        y = _dequant_matmul_pallas(x.reshape(-1, x.shape[-1]), w_int8,
+                                   scale)
+        return y.reshape(*lead, w_int8.shape[-1])
+    return _dequant_matmul_xla(x, w_int8, scale)
+
+
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense`` over a quantized kernel: declares the
+    ``{w_int8, scale[, bias]}`` leaves ``quantize_params`` produces (same
+    module path/name as the dense it replaces) and computes the w8a16 matmul.
+    Zero-init params make ``model.init`` legal on a quant model, but the
+    intended flow is quantizing a trained float tree."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    mode: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        w_int8 = self.param("w_int8", nn.initializers.zeros_init(),
+                            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), jnp.float32)
+        y = dequant_matmul(x.astype(self.dtype), w_int8, scale, mode=self.mode)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.features,), jnp.float32)
+            y = y + bias
+        return y.astype(self.dtype)

@@ -46,10 +46,15 @@ def _spec_for(path: tuple[str, ...], value, axes) -> P:
         return P(*([None] * lead), "expert", *([None] * (ndim - 1 - lead)))
     if "model" not in axes:
         return P()
+    # w8a16 trees (ops/quant.py quantize_params) keep the module paths and
+    # swap kernel → {w_int8, scale}: the int8 matrix shards exactly like the
+    # kernel it encodes; the per-output-channel scale vector follows the
+    # bias rule (sharded with the output dim on column kernels, replicated
+    # on row kernels, whose output dim is unsharded).
     if module in _COL_KERNELS:
-        spec = P(None, "model") if leaf == "kernel" else P("model")
+        spec = P(None, "model") if leaf in ("kernel", "w_int8") else P("model")
     elif module in _ROW_KERNELS:
-        spec = P("model", None) if leaf == "kernel" else P()
+        spec = P("model", None) if leaf in ("kernel", "w_int8") else P()
     else:
         return P()
     if names[0] == "blocks":

@@ -27,6 +27,7 @@ import numpy as np
 
 _SAMPLERS = ("ddim", "cold")
 _CACHE_MODES = ("delta", "full")
+_QUANT_MODES = (None, "xla", "pallas")  # ops/quant.py QUANT_MODES + off
 
 
 @dataclass(frozen=True)
@@ -35,7 +36,9 @@ class SamplerConfig:
 
     Hashable on purpose: it is half of the engine's program-cache key
     ``(config, bucket)``. Two requests share a batch iff their configs are
-    equal — mixed configs never coalesce.
+    equal — mixed configs never coalesce (in particular quant and non-quant
+    requests never share a batch: they run different programs over different
+    param trees).
     """
 
     sampler: str = "ddim"          # "ddim" | "cold"
@@ -44,6 +47,8 @@ class SamplerConfig:
     levels: int = 6                # cold-diffusion levels (cold only)
     cache_interval: int = 1        # 1 = exact sampler; >1 = step cache
     cache_mode: str = "delta"
+    quant: Optional[str] = None    # None = float params; "xla" | "pallas" =
+    # the w8a16 trunk (ops/quant.py) over the engine's int8 param tree
 
     def __post_init__(self):
         if self.sampler not in _SAMPLERS:
@@ -59,6 +64,9 @@ class SamplerConfig:
         if self.cache_mode not in _CACHE_MODES:
             raise ValueError(f"cache_mode must be one of {_CACHE_MODES}, "
                              f"got {self.cache_mode!r}")
+        if self.quant not in _QUANT_MODES:
+            raise ValueError(f"quant must be one of {_QUANT_MODES}, "
+                             f"got {self.quant!r}")
 
     @property
     def cached(self) -> bool:

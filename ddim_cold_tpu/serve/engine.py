@@ -34,6 +34,10 @@ coalescing, padding, nor splitting changes a single bit. This holds for the
 deterministic samplers only — which is why ``SamplerConfig`` has no ``eta``
 (batch-shaped noise draws break row invariance) — and exactly per-backend
 (a mesh reduces in a different order than one device; same as training).
+A quant config keeps the same contract against a direct call on the
+quantized model/params pair (``model.clone(quant=...)`` +
+``quant.quantize_params(params)`` — the deterministic transform the engine
+itself applies).
 """
 
 from __future__ import annotations
@@ -92,11 +96,18 @@ class Engine:
         self._key0 = jax.random.PRNGKey(0)
         self._programs: dict = {}
         self._spare_caches: dict = {}  # bucket -> recycled step-cache carry
+        # w8a16 serving (ops/quant.py): the int8 tree is built ONCE from the
+        # float params on the first quant config and shipped/pinned like the
+        # float tree — every quant dispatch reuses the same device buffers
+        # (≈4× fewer trunk-param bytes over the link than the float tree).
+        self._qparams = None
+        self._quant_models: dict = {}  # quant mode -> model clone (hash key)
         self._pending: list[Request] = []
         self._lock = threading.Lock()
         self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
                       "padded_rows": 0, "max_queue_depth": 0,
-                      "latencies_s": []}
+                      "latencies_s": [], "param_bytes": None,
+                      "param_bytes_quant": None}
 
     # ---------------------------------------------------------------- submit
 
@@ -163,6 +174,31 @@ class Engine:
             self.stats["compiles"] += 1
         return prog
 
+    def _model_for(self, config: SamplerConfig):
+        """The model variant a config's programs trace: ``quant`` is a field
+        of the (hash-by-value) module, so quant and float programs can never
+        collide in jit/AOT caches."""
+        if not config.quant:
+            return self.model
+        model = self._quant_models.get(config.quant)
+        if model is None:
+            model = self._quant_models[config.quant] = self.model.clone(
+                quant=config.quant)
+        return model
+
+    def _params_for(self, config: SamplerConfig):
+        if not config.quant:
+            return self.params
+        if self._qparams is None:
+            from ddim_cold_tpu.ops import quant
+
+            qp = quant.quantize_params(self.params)
+            self._qparams = (shard_params(qp, self.mesh)
+                             if self.mesh is not None else qp)
+            self.stats["param_bytes"] = quant.param_bytes(self.params)
+            self.stats["param_bytes_quant"] = quant.param_bytes(self._qparams)
+        return self._qparams
+
     def _x_struct(self, bucket: int):
         H, W = self.model.img_size
         sharding = batch_sharding(self.mesh) if self.mesh is not None else None
@@ -180,18 +216,19 @@ class Engine:
         structs (no dummy allocation), compile, return the executable. The
         executable is called with the NON-static args only (params, x, …)."""
         x = self._x_struct(bucket)
+        model, params = self._model_for(config), self._params_for(config)
         if config.sampler == "cold":
             if config.cached:
-                return _cold_cached_lower(self.model, self.params, x,
+                return _cold_cached_lower(model, params, x,
                                           self._cache_struct(bucket), config)
             return sampling._cold_scan.lower(
-                self.model, self.params, x, levels=config.levels,
+                model, params, x, levels=config.levels,
                 return_sequence=False).compile()
         if config.cached:
-            return _ddim_cached_lower(self.model, self.params, x, self._key0,
+            return _ddim_cached_lower(model, params, x, self._key0,
                                       self._cache_struct(bucket), config)
         return sampling._ddim_scan_last.lower(
-            self.model, self.params, x, self._key0, k=config.k,
+            model, params, x, self._key0, k=config.k,
             t_start=config.t_start, eta=0.0).compile()
 
     # ------------------------------------------------------------- assembly
@@ -242,19 +279,20 @@ class Engine:
 
     def _dispatch(self, plan: BatchPlan, x: jax.Array):
         prog = self.ensure_program(plan.config, plan.bucket)
+        params = self._params_for(plan.config)
         if plan.config.sampler == "cold":
             if plan.config.cached:
-                out, cache_out = prog(self.params, x,
+                out, cache_out = prog(params, x,
                                       self._take_cache(plan.bucket))
                 self._spare_caches[plan.bucket] = cache_out
             else:
-                out = prog(self.params, x)
+                out = prog(params, x)
         elif plan.config.cached:
-            out, cache_out = prog(self.params, x, self._key0,
+            out, cache_out = prog(params, x, self._key0,
                                   self._take_cache(plan.bucket))
             self._spare_caches[plan.bucket] = cache_out
         else:
-            out = prog(self.params, x, self._key0)
+            out = prog(params, x, self._key0)
         self.stats["dispatches"] += 1
         self.stats["rows"] += plan.rows
         self.stats["padded_rows"] += plan.padded_rows

@@ -27,14 +27,54 @@ PEAK_BF16_TFLOPS = {
     "TPU v2": 46.0,
 }
 
+#: int8 dense peak TOP/s per chip — the MXU rate w8a16 trunk GEMMs are
+#: entitled to (ops/quant.py). v5e/v6e double their bf16 rate at int8;
+#: v4 and earlier have no faster int8 path, so their entry equals bf16 and
+#: mixed-peak MFU degenerates to the plain number there.
+PEAK_INT8_TOPS = {
+    "TPU v6": 1836.0,  # Trillium
+    "TPU v5p": 918.0,
+    "TPU v5 lite": 394.0,  # v5e
+    "TPU v5": 918.0,
+    "TPU v4 lite": 138.0,
+    "TPU v4": 275.0,
+    "TPU v3": 123.0,
+    "TPU v2": 46.0,
+}
 
-def peak_tflops(device_kind: str) -> float | None:
-    """Longest-prefix match of the device kind; None when unknown (CPU etc.)."""
+
+def _prefix_lookup(table: dict, device_kind: str) -> float | None:
     best = None
-    for kind, peak in PEAK_BF16_TFLOPS.items():
+    for kind, peak in table.items():
         if device_kind.startswith(kind) and (best is None or len(kind) > best[0]):
             best = (len(kind), peak)
     return best[1] if best else None
+
+
+def peak_tflops(device_kind: str) -> float | None:
+    """Longest-prefix match of the device kind; None when unknown (CPU etc.)."""
+    return _prefix_lookup(PEAK_BF16_TFLOPS, device_kind)
+
+
+def peak_int8_tops(device_kind: str) -> float | None:
+    """int8 dense peak TOP/s; None when unknown."""
+    return _prefix_lookup(PEAK_INT8_TOPS, device_kind)
+
+
+def mixed_peak_tflops(device_kind: str, int8_fraction: float = 0.0) -> float | None:
+    """Effective peak when ``int8_fraction`` of a step's matmul FLOPs run at
+    the int8 rate and the rest at bf16 — the time-weighted harmonic mix
+    (each fraction contributes its FLOPs/rate to the ideal step time).
+    With no int8 table entry the whole step is charged at bf16 — MFU stays
+    conservative rather than flattering."""
+    bf16 = peak_tflops(device_kind)
+    if bf16 is None:
+        return None
+    f = min(max(float(int8_fraction), 0.0), 1.0)
+    if f == 0.0:
+        return bf16
+    int8 = peak_int8_tops(device_kind) or bf16
+    return 1.0 / (f / int8 + (1.0 - f) / bf16)
 
 
 def vit_forward_flops(*, img_size=(64, 64), patch_size=8, embed_dim=384,
@@ -53,6 +93,22 @@ def vit_forward_flops(*, img_size=(64, 64), patch_size=8, embed_dim=384,
     return 2.0 * (depth * per_block + 2 * patch)          # the same GEMM shape
 
 
+def vit_trunk_gemm_fraction(*, img_size=(64, 64), patch_size=8, embed_dim=384,
+                            depth=7, num_heads=12, mlp_ratio=1.0,
+                            in_chans=3) -> float:
+    """Fraction of the forward's matmul FLOPs in the quantized trunk denses
+    (qkv + proj + MLP; attention score/value GEMMs and patch/head stay
+    bf16) — the ``int8_fraction`` a w8a16 forward feeds ``mfu``, and the
+    analytic-ceiling input for PERF.md's quantization section."""
+    H, W = img_size
+    n = (H // patch_size) * (W // patch_size) + 1
+    d = embed_dim
+    dense = depth * (3 * n * d * d + n * d * d + 2 * n * d * d * mlp_ratio)
+    attn = depth * 2 * n * n * d
+    patch = 2 * n * (patch_size * patch_size * in_chans) * d
+    return dense / (dense + attn + patch)
+
+
 def train_step_flops(batch: int, **model_kwargs) -> float:
     """fwd + bwd ≈ 3× forward (grads w.r.t. inputs and weights each cost one
     forward's worth of matmuls)."""
@@ -60,8 +116,11 @@ def train_step_flops(batch: int, **model_kwargs) -> float:
 
 
 def mfu(flops_per_step: float, step_seconds: float, device_kind: str,
-        n_devices: int = 1) -> float | None:
-    peak = peak_tflops(device_kind)
+        n_devices: int = 1, int8_fraction: float = 0.0) -> float | None:
+    """``int8_fraction`` > 0 charges that share of the FLOPs at the chip's
+    int8 peak (w8a16 trunks, ops/quant.py) — the denominator grows, so a
+    quantized run's MFU stays honest instead of flattering."""
+    peak = mixed_peak_tflops(device_kind, int8_fraction)
     if peak is None or step_seconds <= 0:
         return None
     return flops_per_step / (step_seconds * peak * 1e12 * n_devices)

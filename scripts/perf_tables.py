@@ -27,10 +27,14 @@ def render(path: str) -> str:
         return f"<!-- {path}: no parseable record -->"
     sub = rec.get("submetrics", {})
     lines = [f"### {os.path.relpath(path, REPO)}", ""]
+    revs = " · ".join(f"{lbl} `{sub[key]}`" for lbl, key in
+                      (("kernel", "kernel_rev"), ("quant", "quant_rev"))
+                      if sub.get(key))
     lines += [f"chip: **{rec.get('chip')}** · headline "
               f"**{rec.get('value')} img/s** @ b32 "
               f"({rec.get('vs_baseline')}× the 702 img/s 3090 baseline) · "
-              f"{rec.get('ms_per_step')} ms/step · MFU {rec.get('mfu')}", ""]
+              f"{rec.get('ms_per_step')} ms/step · MFU {rec.get('mfu')}"
+              + (f" · {revs}" if revs else ""), ""]
     if rec.get("captured_earlier"):
         ce = sub.get("captured_earlier", {})
         lines += [f"> REUSED record ({ce.get('file')}"
@@ -58,12 +62,21 @@ def render(path: str) -> str:
                 + (f", MFU {100 * r['mfu']:.1f}%" if r.get("mfu") else ""))
 
     ns = {s: sub.get("sampler_throughput_200px_k20" + s)
-          for s in ("", "_dense", "_flash", "_xla", "_flash_n64")}
+          for s in ("", "_dense", "_flash", "_xla", "_flash_n64",
+                    "_cached", "_cached_delta", "_flash_w8a16")}
     if any(ns.values()):
         lines.append("")
         lines.append("**200px k=20 north-star (img/s/chip):** "
                      + " · ".join(f"{(s or '_best')[1:]}={v['value']}"
                                   for s, v in ns.items() if v))
+    w8 = ns.get("_flash_w8a16")
+    if w8:
+        lines.append(
+            f"w8a16 flash leg: {w8.get('speedup_vs_bf16_flash')}× vs bf16 "
+            f"flash · pixel drift {w8.get('max_abs_pixel_delta')} · param "
+            f"bytes {w8.get('param_bytes')} → {w8.get('param_bytes_quant')}"
+            + (f" · trunk GEMM fraction {w8['trunk_gemm_fraction']}"
+               if w8.get("trunk_gemm_fraction") is not None else ""))
     sweep = sub.get("northstar_flash_block_sweep")
     if sweep:
         lines.append("flash block sweep: "
@@ -79,6 +92,49 @@ def render(path: str) -> str:
         lines.append("")
         lines.append("**k-sweep 64px (img/s):** "
                      + " · ".join(f"k={k}: {v}" for k, v in ks.items()))
+
+    q64 = sub.get("sampler_64px_w8a16")
+    if q64:
+        lines.append("")
+        lines.append(
+            f"**w8a16 64px (k={q64.get('k')}, n={q64.get('n')}):** "
+            + " · ".join(
+                f"{m}={leg['img_per_sec']} img/s "
+                f"({leg['speedup_vs_float']}× float, "
+                f"drift {leg['max_abs_pixel_delta']})"
+                for m, leg in q64.get("modes", {}).items()
+                if "img_per_sec" in leg)
+            + f" · float={q64.get('float_img_per_sec')} img/s · param bytes "
+              f"{q64.get('param_bytes')} → {q64.get('param_bytes_quant')}")
+
+    srv = sub.get("serving")
+    if srv:
+        lines.append("")
+        lines.append(
+            f"**serving:** {srv.get('img_per_sec')} img/s "
+            f"({srv.get('vs_oneshot')}× one-shot) · p50 "
+            f"{srv.get('p50_latency_s')}s / p95 {srv.get('p95_latency_s')}s · "
+            f"compiles after warmup {srv.get('compiles_after_warmup')}")
+        sq = srv.get("quant")
+        if sq:
+            lines.append(
+                f"serving w8a16: {sq.get('img_per_sec')} img/s "
+                f"({sq.get('vs_float_serving')}× float serving) · param bytes "
+                f"{sq.get('param_bytes')} → {sq.get('param_bytes_quant')} · "
+                f"compiles after warmup {sq.get('compiles_after_warmup')}")
+
+    for key, label in (("cached_quality_64px", "cached quality 64px"),
+                       ("quant_quality_64px", "w8a16 quality 64px"),
+                       ("quant_cached_quality_64px",
+                        "w8a16 × cache quality 64px")):
+        g = sub.get(key)
+        if g:
+            dist = g.get("fid_exact_vs_cached", g.get("fid_exact_vs_quant"))
+            lines.append("")
+            lines.append(
+                f"**{label}:** paired Fréchet {dist} · pixel drift "
+                f"{g.get('max_abs_pixel_delta')} (n={g.get('n_samples')}, "
+                f"k={g.get('k')}, interval={g.get('cache_interval')})")
     e2e = [(lbl, sub.get(f"e2e_train_throughput_{lbl}"))
            for lbl in ("cold", "warm")]
     if any(v for _, v in e2e):

@@ -52,6 +52,12 @@ import pytest  # noqa: E402
 # outer timeout), so it must stay well under budget/2.
 _ISOLATED_CHILD_ENV = "DDIM_COLD_TPU_ISOLATED_CHILD"
 _ISOLATED_TIMEOUT_S = float(os.environ.get("DDIM_COLD_ISOLATED_TIMEOUT_S", "150"))
+# Suite-wide cap on signal-death retries. A single flaky crash gets its one
+# retry; a host where the native crash is DETERMINISTIC (dozens of isolated
+# tests die every run) must not pay 2× child runtime per crash — that alone
+# can blow the 870 s tier-1 budget. Once the budget is spent, further signal
+# deaths fail immediately, exactly as before the retry existed.
+_retry_budget = int(os.environ.get("DDIM_COLD_ISOLATED_RETRIES", "3"))
 
 
 def pytest_configure(config):
@@ -74,17 +80,34 @@ def pytest_runtest_protocol(item, nextitem):
     env = dict(os.environ, **{_ISOLATED_CHILD_ENV: "1"})
     cmd = [sys.executable, "-m", "pytest", "-q", "-x",
            "-p", "no:cacheprovider", item.nodeid]
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, env=env,
-            cwd=str(item.config.rootpath), timeout=_ISOLATED_TIMEOUT_S,
-        )
-        rc = proc.returncode
-        out = (proc.stdout or "") + (proc.stderr or "")
-    except subprocess.TimeoutExpired as exc:
-        rc = -1
-        out = ((exc.stdout or b"").decode(errors="replace")
-               + f"\nisolated subprocess timed out after {_ISOLATED_TIMEOUT_S:g}s")
+
+    def attempt():
+        """Run the child once → (returncode, output, timed_out)."""
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, env=env,
+                cwd=str(item.config.rootpath), timeout=_ISOLATED_TIMEOUT_S,
+            )
+            return proc.returncode, (proc.stdout or "") + (proc.stderr or ""), False
+        except subprocess.TimeoutExpired as exc:
+            out = ((exc.stdout or b"").decode(errors="replace")
+                   + f"\nisolated subprocess timed out after {_ISOLATED_TIMEOUT_S:g}s")
+            return -1, out, True
+
+    rc, out, timed_out = attempt()
+    flaky_note = None
+    global _retry_budget
+    if rc < 0 and not timed_out and _retry_budget > 0:
+        # The documented flaky-host class: the child was KILLED BY A SIGNAL
+        # (SIGSEGV/SIGABRT from the native-heap corruption this runner exists
+        # to contain). Retry exactly once — a real regression that crashes
+        # deterministically crashes the retry too and still fails; ordinary
+        # assertion failures (rc > 0) and deadlocks (the timeout path) are
+        # never retried, so nothing real is masked.
+        _retry_budget -= 1
+        flaky_note = (f"first attempt died with signal {-rc}; "
+                      "retried once (flaky-host native-crash class)")
+        rc, out, timed_out = attempt()
     duration = time.time() - start
     if rc == 0 and re.search(r"\b1 skipped\b", out) and not re.search(r"\b1 passed\b", out):
         outcome = "skipped"
@@ -97,11 +120,18 @@ def pytest_runtest_protocol(item, nextitem):
         tail = "\n".join(out.splitlines()[-40:])
         why = (f"isolated subprocess died with signal {-rc}" if rc < 0
                else f"isolated subprocess exited with code {rc}")
+        if flaky_note:
+            why = f"{flaky_note}; retry then {why}"
         longrepr = f"{why}\n{tail}"
+    keywords = {item.name: 1}
+    sections = []
+    if flaky_note:
+        keywords["flaky-retry"] = 1
+        sections.append(("flaky-retry", flaky_note))
     report = pytest.TestReport(
         nodeid=item.nodeid, location=item.location,
-        keywords={item.name: 1}, outcome=outcome, longrepr=longrepr,
-        when="call", sections=[], duration=duration,
+        keywords=keywords, outcome=outcome, longrepr=longrepr,
+        when="call", sections=sections, duration=duration,
         start=start, stop=start + duration,
     )
     hook.pytest_runtest_logreport(report=report)

@@ -48,6 +48,30 @@ def test_bench_serving_smoke_record(capsys):
     assert srv["max_queue_depth"] >= 1
 
 
+def test_bench_quant_smoke_record(capsys):
+    """The --quant 64px leg must record both dequant-matmul modes with
+    paired drift + the param-byte saving, and stamp quant_rev next to
+    kernel_rev (stale-record protection keys off both)."""
+    import bench
+    from ddim_cold_tpu.ops.quant import QUANT_REV
+
+    bench.main(["--smoke", "--cpu", "--steps", "3", "--batch", "4",
+                "--skip-sampler", "--no-ksweep", "--quant"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    sub = rec["submetrics"]
+    assert sub["quant_rev"] == QUANT_REV and "kernel_rev" in sub
+    q = sub["sampler_64px_w8a16"]
+    assert q["param_bytes_quant"] < q["param_bytes"]
+    assert q["float_img_per_sec"] > 0
+    for mode in ("xla", "pallas"):
+        leg = q["modes"][mode]
+        assert np.isfinite(leg["img_per_sec"]) and leg["img_per_sec"] > 0
+        assert np.isfinite(leg["speedup_vs_float"])
+        # bf16 model: quant noise rides under the bf16 activation noise
+        assert leg["max_abs_pixel_delta"] < 0.1
+
+
 def test_bench_stall_watchdog_emits_partial_record():
     """A wedged RPC mid-run (tunnel drop: the call blocks forever, no
     exception) must still produce a parseable record: the watchdog emits the

@@ -334,6 +334,81 @@ def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
     assert calls.count("_fwd_kernel") == 6 and len(calls) == 12, calls
 
 
+def _sub_jaxprs(val):
+    """Jaxpr-valued payloads inside an eqn param (Jaxpr, ClosedJaxpr, lists)."""
+    if hasattr(val, "eqns"):
+        return [val]
+    if hasattr(val, "jaxpr"):
+        return [val.jaxpr]
+    if isinstance(val, (list, tuple)):
+        return [j for item in val for j in _sub_jaxprs(item)]
+    return []
+
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                yield from _iter_eqns(sub)
+
+
+def _kernel_dot_eqns(jaxpr):
+    """dot_general eqns INSIDE pallas_call kernel bodies (the MXU GEMMs)."""
+    dots = []
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        inner = eqn.params["jaxpr"]
+        inner = getattr(inner, "jaxpr", inner)
+        dots += [e for e in _iter_eqns(inner)
+                 if e.primitive.name == "dot_general"]
+    return dots
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float32"])
+def test_kernel_gemms_run_in_input_dtype_with_f32_accumulation(dtype):
+    """CPU guard for the bf16-gemm-v2 contract, on TRACED dtypes: every GEMM
+    inside the Pallas kernels (forward AND both backward kernels) must take
+    its operands in the model's input dtype — an explicit f32 upcast would
+    silently cost ~4× MXU throughput on v5e and double VMEM traffic, which no
+    numerics test can see — while accumulating in f32 via
+    preferred_element_type (which every parity test above DOES depend on).
+    Asserting on the jaxpr pins both halves of the contract on CPU, where the
+    perf regression itself is unmeasurable. Keyed to KERNEL_REV so a future
+    kernel revision must revisit this contract explicitly rather than
+    inheriting a stale guard."""
+    from ddim_cold_tpu.ops import flash_attention as fa
+
+    assert fa.KERNEL_REV == "bf16-gemm-v2", (
+        "kernel revision changed — re-derive the GEMM dtype contract here")
+
+    dt = jnp.dtype(dtype)
+    q, k, v = (x.astype(dt) for x in _rand_qkv(23, 1, 64, 2, 8))
+    scale = 8**-0.5
+
+    fwd = jax.make_jaxpr(lambda q, k, v: flash_attention(q, k, v, scale))(q, k, v)
+    fwd_dots = _kernel_dot_eqns(fwd.jaxpr)
+    assert len(fwd_dots) == 2, fwd_dots  # q·kᵀ logits + p·v
+
+    bwd = jax.make_jaxpr(jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, scale).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    # fwd rerun (2) + dq kernel (logits, dp, ds·k) + dkv kernel
+    # (logits, pᵀ·do, dp, dsᵀ·q) = 9; ≥ 7 tolerates residual-sharing tweaks
+    bwd_dots = _kernel_dot_eqns(bwd.jaxpr)
+    assert len(bwd_dots) >= 7, bwd_dots
+
+    for eqn in fwd_dots + bwd_dots:
+        pref = eqn.params.get("preferred_element_type")
+        assert pref is not None and jnp.dtype(pref) == jnp.float32, eqn
+        for invar in eqn.invars:
+            assert invar.aval.dtype == dt, (
+                f"kernel GEMM operand traced as {invar.aval.dtype}, "
+                f"expected input dtype {dt}: {eqn}")
+
+
 from ddim_cold_tpu.ops.flash_attention import blockwise_attention_xla  # noqa: E402
 
 
