@@ -169,6 +169,16 @@ def main(argv=None):
                          "p50/p95 request latency, queue depth and "
                          "compiles-after-warmup; composes with --smoke for "
                          "a CPU-budget run")
+    ap.add_argument("--faults", action="store_true",
+                    help="run the robustness leg (utils/faults.py + the "
+                         "fault-tolerant engine): a disarmed drain (must "
+                         "match the plain serving numbers — the "
+                         "zero-overhead-disarmed guarantee) then the same "
+                         "stream under a FIXED seeded fault schedule, "
+                         "recording degraded-mode throughput, recovery "
+                         "counters (retries/quarantined/failed) and "
+                         "compiles-after-warmup (recovery never compiles); "
+                         "composes with --smoke for a CPU-budget run")
     ap.add_argument("--quant", action="store_true",
                     help="run the w8a16 quantized-inference legs "
                          "(ops/quant.py): 64px sampler in both dequant-matmul "
@@ -691,6 +701,81 @@ def main(argv=None):
 
         if args.serving:
             section("serving", run_serving)
+
+        def run_faults():
+            # the robustness leg: same mixed stream twice through a
+            # fault-tolerant engine — once DISARMED (the zero-overhead
+            # guarantee: this must match the plain serving drain, and the
+            # fault hooks must cost nothing on the fast path), once under a
+            # FIXED seeded fault schedule (degraded mode: retries absorb
+            # transients, bisection quarantines the one poisoned request,
+            # everyone else completes). Recovery re-packs at the warmed
+            # buckets, so compiles-after-warmup stays zero in BOTH drains.
+            from ddim_cold_tpu import serve
+            from ddim_cold_tpu.utils import faults as fj
+
+            buckets = (2, 4) if args.smoke else (8, 32)
+            k_serve = 400 if args.smoke else 20
+            bmax = max(buckets)
+            cfg = serve.SamplerConfig(k=k_serve)
+            engine = serve.Engine(model, state.params, buckets=buckets)
+            mark(f"faults warmup buckets={buckets}", budget_s=2 * stall_s)
+            wu = serve.warmup(engine, [cfg])
+            sizes = [bmax + 1, 1, bmax // 2, bmax, bmax // 2 - 1, bmax - 1]
+            short = -(-sum(sizes) // bmax) * bmax - sum(sizes)
+            if short:
+                sizes.append(short)
+
+            def drain(seed0):
+                for i, n_req in enumerate(sizes):
+                    engine.submit(seed=seed0 + i, n=n_req, config=cfg)
+                return engine.run()
+
+            assert not fj.active()
+            mark("faults clean drain")
+            clean = drain(300)
+            poison_rid = engine._next_rid + 2  # third request of the stream
+            schedule = (
+                fj.FaultSpec("serve.dispatch", "transient", rate=0.3,
+                             seed=11),
+                fj.FaultSpec("serve.dispatch", "permanent",
+                             match=f"req:{poison_rid}|"),
+                fj.FaultSpec("serve.fetch", "latency", rate=0.2, seed=5,
+                             latency_s=0.02),
+            )
+            mark("faults chaos drain")
+            with fj.inject(*schedule) as plan:
+                chaos = drain(400)
+                injected, by_site = len(plan.realized), plan.by_site()
+            sub["faults"] = {
+                "clean_img_per_sec": round(clean["img_per_sec"], 2),
+                "chaos_img_per_sec": round(chaos["img_per_sec"], 2),
+                "degraded_ratio": round(
+                    chaos["img_per_sec"] / clean["img_per_sec"], 3)
+                if clean["img_per_sec"] else None,
+                "injected": injected, "by_site": by_site,
+                "retries": chaos["retries"],
+                "quarantined": chaos["quarantined"],
+                "failed_tickets": chaos["failed_tickets"],
+                "rows": chaos["rows"],
+                "compiles_after_warmup": clean["compiles"] + chaos["compiles"],
+                "warmup_new_compiles": wu["new_compiles"],
+                "stream_sizes": sizes, "buckets": list(buckets), "k": k_serve,
+            }
+            serving = sub.get("serving")
+            if serving:  # disarmed must match the plain-engine numbers
+                sub["faults"]["disarmed_vs_serving"] = round(
+                    clean["img_per_sec"] / serving["img_per_sec"], 3)
+            log(f"faults: clean {clean['img_per_sec']:.2f} img/s, chaos "
+                f"{chaos['img_per_sec']:.2f} img/s (ratio "
+                f"{sub['faults']['degraded_ratio']}) under {injected} "
+                f"injections {by_site}; retries {chaos['retries']}, "
+                f"quarantined {chaos['quarantined']}, failed "
+                f"{chaos['failed_tickets']}; compiles after warmup: "
+                f"{sub['faults']['compiles_after_warmup']}")
+
+        if args.faults:
+            section("faults", run_faults)
 
         def run_quant64():
             # w8a16 sampler legs at 64px (ops/quant.py), both dequant-matmul

@@ -36,6 +36,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from ddim_cold_tpu.utils import faults
+
 
 class ShardedLoader:
     """Iterable over host-local batches of ``(noisy, target, t)`` numpy arrays."""
@@ -115,6 +117,10 @@ class ShardedLoader:
         return noisy, target, t
 
     def _make_batch(self, idxs: np.ndarray, pool: Optional[ThreadPoolExecutor] = None):
+        # chaos hook: covers the threaded and unthreaded iteration paths
+        # alike (an injected raise here surfaces at the consumer's next(),
+        # exactly like a real decode failure would)
+        faults.fire("data.next", tag=f"epoch:{self.epoch}|")
         if self.raw:  # (base, t) only — corruption happens on device (in-jit)
             return self.dataset.get_raw_batch(
                 idxs, num_threads=max(1, self.num_threads), pool=pool)

@@ -25,9 +25,16 @@ from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
                                           Ticket, cover_rows, plan_batches,
                                           select_bucket)
 from ddim_cold_tpu.serve.engine import Engine
+from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
+                                        EngineClosedError, EngineStalledError,
+                                        QueueFullError, RequestFailedError,
+                                        RequestQuarantinedError, ServeError)
 from ddim_cold_tpu.serve.warmup import warmup
 
 __all__ = [
-    "BatchPlan", "Engine", "Request", "SamplerConfig", "Ticket",
-    "cover_rows", "plan_batches", "select_bucket", "warmup",
+    "BatchPlan", "DeadlineExceeded", "Engine", "EngineClosedError",
+    "EngineStalledError", "QueueFullError", "Request", "RequestFailedError",
+    "RequestQuarantinedError", "RETRYABLE_EXCEPTIONS", "SamplerConfig",
+    "ServeError", "Ticket", "cover_rows", "plan_batches", "select_bucket",
+    "warmup",
 ]

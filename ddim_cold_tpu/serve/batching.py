@@ -76,7 +76,12 @@ class SamplerConfig:
 class Ticket:
     """Per-request future. The engine delivers row ranges as their batches
     come off the device (a split request completes over several batches);
-    ``result()`` blocks until every row has landed."""
+    ``result()`` blocks until every row has landed — or until the request
+    FAILS, in which case it re-raises the failure with the engine-stage
+    exception as cause. ``done`` reflects both outcomes (a resolved error
+    counts as done), so a caller that saw a ``result(timeout=)`` timeout
+    can keep observing the ticket: a late-landing buffer or a late failure
+    both flip ``done`` and are readable via ``result()``/``exception()``."""
 
     def __init__(self, n: int):
         self.n = int(n)
@@ -86,10 +91,16 @@ class Ticket:
         self._event = threading.Event()
         self._buf: Optional[np.ndarray] = None
         self._remaining = int(n)
+        self._error: Optional[BaseException] = None
+        self._health_cb = None  # engine attaches its health snapshot hook
 
     def _deliver(self, lo: int, hi: int, rows: np.ndarray) -> bool:
-        """Engine-side: land request rows [lo, hi). True when complete."""
+        """Engine-side: land request rows [lo, hi). True when complete.
+        Rows landing after the ticket failed are dropped (the error is the
+        outcome; a half-filled buffer must never masquerade as a result)."""
         with self._lock:
+            if self._error is not None:
+                return False
             if self._buf is None:
                 self._buf = np.empty((self.n,) + rows.shape[1:], rows.dtype)
             self._buf[lo:hi] = rows
@@ -100,9 +111,26 @@ class Ticket:
             self._event.set()
         return done
 
+    def _fail(self, exc: BaseException) -> bool:
+        """Engine-side: resolve the ticket as failed. First resolution wins
+        (a ticket that already completed, or already failed, is untouched);
+        returns True when THIS call resolved it."""
+        with self._lock:
+            if self._event.is_set() or self._error is not None:
+                return False
+            self._error = exc
+        self.done_time = time.perf_counter()
+        self._event.set()
+        return True
+
     @property
     def done(self) -> bool:
+        """True once the ticket is RESOLVED — completed or failed."""
         return self._event.is_set()
+
+    @property
+    def failed(self) -> bool:
+        return self._error is not None
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -110,11 +138,29 @@ class Ticket:
             return None
         return self.done_time - self.submit_time
 
+    def _timeout_msg(self, timeout) -> str:
+        base = (f"ticket for {self.n} rows not complete after {timeout}s "
+                f"({self._remaining} rows outstanding)")
+        if self._health_cb is not None:
+            try:
+                return f"{base}; engine health: {self._health_cb()}"
+            except Exception:  # noqa: BLE001 — diagnostics must not mask
+                return base
+        return base + " — no engine attached (did Engine.run() run?)"
+
+    def exception(self, timeout: Optional[float] = None):
+        """The request's failure, or None if it completed
+        (concurrent.futures semantics: blocks up to ``timeout``, raising
+        TimeoutError — with the engine health snapshot — if unresolved)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(self._timeout_msg(timeout))
+        return self._error
+
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._event.wait(timeout):
-            raise TimeoutError(
-                f"ticket for {self.n} rows not complete after {timeout}s "
-                f"({self._remaining} rows outstanding) — did Engine.run() run?")
+            raise TimeoutError(self._timeout_msg(timeout))
+        if self._error is not None:
+            raise self._error
         return self._buf
 
 
@@ -129,6 +175,13 @@ class Request:
     key: Optional[object] = None
     x_init: Optional[object] = None
     ticket: Ticket = field(default_factory=lambda: Ticket(0))
+    #: engine-assigned id (submit order); fault tags and quarantine records
+    #: name requests by it
+    rid: int = -1
+    #: absolute deadline (time.perf_counter() clock); None = no deadline.
+    #: Enforced at plan time and again at dispatch time — an expired request
+    #: fails fast with DeadlineExceeded instead of occupying a bucket.
+    deadline: Optional[float] = None
     # memo for the assembly thread: the request's full x_init drawn ONCE at
     # its own n (the draw depends on n, slicing does not), shared by every
     # batch the request's rows land in

@@ -24,20 +24,43 @@ Throughput comes from three structural moves, none of which touch the math:
   contents are never read) — cached serving allocates its cache once per
   bucket, ever.
 
+**Failure isolation.** Every pipeline stage (assembly → dispatch → fetch) is
+wrapped so an exception fails only the tickets of the batch it struck — the
+engine keeps serving subsequent batches. Retryable faults (the transfer/RPC
+class, ``errors.RETRYABLE_EXCEPTIONS``) get capped exponential backoff with
+the donated input rebuilt per attempt; a batch that fails deterministically
+is BISECTED on request boundaries — each half re-assembles (padded to the
+same compiled bucket, so recovery never compiles) and re-dispatches until
+the poisoned request is isolated and quarantined
+(:class:`~.errors.RequestQuarantinedError`, stage exception as cause) while
+its innocent batchmates complete. Admission control bounds the queue
+(``max_queue`` → :class:`~.errors.QueueFullError` at submit) and per-request
+deadlines are enforced at plan AND dispatch time (expired requests fail fast
+with :class:`~.errors.DeadlineExceeded` instead of occupying a bucket).
+:meth:`Engine.drain` stops admission, flushes in-flight batches, and
+deterministically fails queued tickets. A soft-mode
+:class:`~ddim_cold_tpu.utils.watchdog.StallWatchdog` bounds every silent
+device window (a wedged tunnel hangs native calls with NO exception to
+catch — the r03/r05 lesson): on stall it fails in-flight and queued tickets
+(partial results already fetched stand) instead of hanging every waiter.
+Chaos coverage injects faults at the ``serve.*`` sites
+(utils/faults.py); with faults disarmed the fast path executes
+byte-identical device code.
+
 **Bitwise contract.** Engine output rows are bitwise identical to a direct
 ``ddim_sample``/``cold_sample``/``sample_from`` call with the same request
 rng: the engine draws each request's init at the request's OWN ``n`` with the
 request's own key (exactly the draw the direct call makes — the values depend
 on ``n``), and row slices of that draw keep their bits; every sampler row is
 then computed independently of its batchmates (per-row trunk), so neither
-coalescing, padding, nor splitting changes a single bit. This holds for the
-deterministic samplers only — which is why ``SamplerConfig`` has no ``eta``
-(batch-shaped noise draws break row invariance) — and exactly per-backend
-(a mesh reduces in a different order than one device; same as training).
-A quant config keeps the same contract against a direct call on the
-quantized model/params pair (``model.clone(quant=...)`` +
-``quant.quantize_params(params)`` — the deterministic transform the engine
-itself applies).
+coalescing, padding, splitting, nor bisection recovery changes a single bit.
+This holds for the deterministic samplers only — which is why
+``SamplerConfig`` has no ``eta`` (batch-shaped noise draws break row
+invariance) — and exactly per-backend (a mesh reduces in a different order
+than one device; same as training). A quant config keeps the same contract
+against a direct call on the quantized model/params pair
+(``model.clone(quant=...)`` + ``quant.quantize_params(params)`` — the
+deterministic transform the engine itself applies).
 """
 
 from __future__ import annotations
@@ -56,7 +79,14 @@ from ddim_cold_tpu.ops import sampling, step_cache
 from ddim_cold_tpu.parallel.mesh import batch_sharding, data_axis_size, shard_params
 from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
                                           Ticket, plan_batches)
+from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
+                                        EngineClosedError, EngineStalledError,
+                                        QueueFullError, RequestFailedError,
+                                        RequestQuarantinedError)
+from ddim_cold_tpu.utils import faults
+from ddim_cold_tpu.utils.platform import watchdog_stall_s
 from ddim_cold_tpu.utils.profiling import latency_summary
+from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
 
 class Engine:
@@ -72,11 +102,16 @@ class Engine:
 
     ``submit`` is thread-safe and returns immediately; ``run`` drains the
     queue (requests submitted mid-run join the next planning round).
+    ``drain()`` closes admission and fails anything still queued.
     """
 
     def __init__(self, model, params, mesh=None,
                  buckets: Sequence[int] = (8, 32, 128), *,
-                 prefetch_depth: int = 2, inflight: int = 2):
+                 prefetch_depth: int = 2, inflight: int = 2,
+                 max_queue: Optional[int] = None,
+                 max_retries: int = 2, retry_base_s: float = 0.05,
+                 retry_cap_s: float = 1.0,
+                 stall_s: Optional[float] = None):
         self.model = model
         self.mesh = mesh
         self.buckets = tuple(sorted({int(b) for b in buckets}))
@@ -91,6 +126,17 @@ class Engine:
         self.params = shard_params(params, mesh) if mesh is not None else params
         self.prefetch_depth = int(prefetch_depth)
         self.inflight = max(1, int(inflight))
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 or None, got {max_queue}")
+        self.max_queue = max_queue
+        self.max_retries = int(max_retries)
+        self.retry_base_s = float(retry_base_s)
+        self.retry_cap_s = float(retry_cap_s)
+        # stall budget for silent device windows; shared arm-condition with
+        # the evidence scripts (0 on a local cpu backend unless the env
+        # overrides — no tunnel to wedge there)
+        self.stall_s = (watchdog_stall_s("DDIM_COLD_SERVE_STALL_S", 900.0)
+                        if stall_s is None else float(stall_s))
         # any key works here: the deterministic scans never read noise_rng
         # (eta is pinned to 0.0 at program build — see module docstring)
         self._key0 = jax.random.PRNGKey(0)
@@ -103,18 +149,32 @@ class Engine:
         self._qparams = None
         self._quant_models: dict = {}  # quant mode -> model clone (hash key)
         self._pending: list[Request] = []
+        self._open: dict = {}  # rid -> unresolved Request (stall fail set)
         self._lock = threading.Lock()
+        self._next_rid = 0
+        self._closed = False
+        self._stalled = False
+        self._running = False
+        self._wd: Optional[StallWatchdog] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self.quarantined: list[int] = []  # rids bisection isolated
         self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
                       "padded_rows": 0, "max_queue_depth": 0,
                       "latencies_s": [], "param_bytes": None,
-                      "param_bytes_quant": None}
+                      "param_bytes_quant": None,
+                      # robustness counters (health snapshot)
+                      "retries": 0, "failed_batches": 0, "failed_tickets": 0,
+                      "quarantined": 0, "deadline_expired": 0, "rejected": 0,
+                      "skipped_batches": 0, "stalls": 0}
 
     # ---------------------------------------------------------------- submit
 
     def submit(self, seed: Optional[int] = None, n: int = 1, *,
                rng: Optional[jax.Array] = None,
                x_init: Optional[np.ndarray] = None,
-               config: Optional[SamplerConfig] = None, **kwargs) -> Ticket:
+               config: Optional[SamplerConfig] = None,
+               deadline_s: Optional[float] = None, **kwargs) -> Ticket:
         """Queue a sampling request; returns its :class:`Ticket`.
 
         Fresh starts pass ``seed`` (or a jax ``rng`` key) — the engine draws
@@ -122,6 +182,12 @@ class Engine:
         pass ``x_init`` (an (n, H, W, C) or (H, W, C) encoded start; pair it
         with ``t_start`` — the ``sample_from`` path). Sampler options go in
         ``config`` or as keyword args (``k=, t_start=, cache_interval=, …``).
+
+        ``deadline_s`` bounds the request's total time in the engine: past
+        it, the request fails fast with :class:`DeadlineExceeded` instead of
+        occupying a bucket. Raises :class:`QueueFullError` when the bounded
+        queue is at ``max_queue`` and :class:`EngineClosedError` after
+        :meth:`drain`.
         """
         if config is None:
             config = SamplerConfig(**kwargs)
@@ -147,10 +213,27 @@ class Engine:
             key = rng
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        deadline = (time.perf_counter() + deadline_s
+                    if deadline_s is not None else None)
         req = Request(config=config, n=int(n), key=key, x_init=x_init,
-                      ticket=Ticket(n))
+                      ticket=Ticket(n), deadline=deadline)
+        req.ticket._health_cb = self.health
         with self._lock:
+            if self._closed:
+                raise EngineClosedError(
+                    "engine is drained — no new requests accepted")
+            if self.max_queue is not None and len(self._pending) >= self.max_queue:
+                self.stats["rejected"] += 1
+                raise QueueFullError(
+                    f"queue at max_queue={self.max_queue} "
+                    f"({len(self._pending)} pending) — request rejected "
+                    "(overload backpressure; retry later or raise max_queue)")
+            req.rid = self._next_rid
+            self._next_rid += 1
             self._pending.append(req)
+            self._open[req.rid] = req
             depth = len(self._pending)
         self.stats["max_queue_depth"] = max(self.stats["max_queue_depth"], depth)
         return req.ticket
@@ -169,6 +252,8 @@ class Engine:
         key = (config, bucket)
         prog = self._programs.get(key)
         if prog is None:
+            faults.fire("serve.compile", tag=f"bucket:{bucket}|")
+            self._mark(f"compile bucket={bucket}", budget_s=4 * self.stall_s)
             prog = self._build_program(config, bucket)
             self._programs[key] = prog
             self.stats["compiles"] += 1
@@ -251,10 +336,19 @@ class Engine:
                                                 jnp.float32)
         return req._x_full
 
+    def _tag(self, plan: BatchPlan) -> str:
+        """Fault/beacon tag: ``|``-separated fields naming the bucket and
+        every request in the batch (``match="req:3|"`` targets request 3)."""
+        reqs = {id(req): req for req, *_ in plan.entries}
+        return (f"bucket:{plan.bucket}|"
+                + "".join(f"req:{r.rid}|" for r in reqs.values()))
+
     def _assemble(self, plan: BatchPlan):
         """Background-thread H2D stage: build the padded bucket batch on
         device (init draws dispatch async; guided numpy starts upload here,
         overlapping the main loop's compute)."""
+        self._mark(f"assemble bucket={plan.bucket}")
+        faults.fire("serve.assemble", tag=self._tag(plan))
         parts = [self._request_init(req)[lo:hi]
                  for req, lo, hi, _ in plan.entries]
         if plan.padded_rows:
@@ -265,6 +359,17 @@ class Engine:
         if self.mesh is not None:
             x = jax.device_put(x, batch_sharding(self.mesh))
         return plan, x
+
+    def _assemble_safe(self, plan: BatchPlan):
+        """Assembly with the exception CAPTURED, not raised — the prefetch
+        generator must keep producing the other plans when one batch's
+        assembly fails (device_prefetch forwards a raise to the consumer and
+        stops, which would strand every later batch)."""
+        try:
+            plan, x = self._assemble(plan)
+            return plan, x, None
+        except Exception as exc:  # noqa: BLE001 — isolated per batch
+            return plan, None, exc
 
     # ------------------------------------------------------------- dispatch
 
@@ -280,6 +385,8 @@ class Engine:
     def _dispatch(self, plan: BatchPlan, x: jax.Array):
         prog = self.ensure_program(plan.config, plan.bucket)
         params = self._params_for(plan.config)
+        self._mark(f"dispatch bucket={plan.bucket}")
+        faults.fire("serve.dispatch", tag=self._tag(plan))
         if plan.config.sampler == "cold":
             if plan.config.cached:
                 out, cache_out = prog(params, x,
@@ -298,42 +405,259 @@ class Engine:
         self.stats["padded_rows"] += plan.padded_rows
         return out
 
+    def _dispatch_retry(self, plan: BatchPlan, x: jax.Array):
+        """Dispatch with capped exponential backoff on the retryable fault
+        class. The donated input is rebuilt per attempt when the failed call
+        already consumed it (donation deletes the buffer even on error)."""
+        delay = self.retry_base_s
+        for attempt in range(self.max_retries + 1):
+            try:
+                return self._dispatch(plan, x)
+            except RETRYABLE_EXCEPTIONS:
+                if attempt == self.max_retries:
+                    raise
+                self.stats["retries"] += 1
+                time.sleep(min(delay, self.retry_cap_s))
+                delay = min(delay * 2, self.retry_cap_s)
+                if getattr(x, "is_deleted", lambda: False)():
+                    _, x, err = self._assemble_safe(plan)
+                    if err is not None:
+                        raise err
+        raise AssertionError("unreachable: loop returns or raises")
+
+    def _subplan(self, plan: BatchPlan, entries) -> BatchPlan:
+        """A sub-batch of ``entries`` repacked densely at the SAME bucket —
+        bisection recovery reuses the compiled program, it never compiles."""
+        packed, offset = [], 0
+        for req, lo, hi, _ in entries:
+            packed.append((req, lo, hi, offset))
+            offset += hi - lo
+        return BatchPlan(config=plan.config, bucket=plan.bucket,
+                         entries=tuple(packed), rows=offset)
+
+    def _dispatch_safe(self, plan: BatchPlan, x) -> list:
+        """Dispatch with full failure isolation; returns the list of
+        (plan, out) that actually went to the device.
+
+        Deadlines are re-checked here (plan-time admission already filtered,
+        but a request can expire while earlier batches run): expired entries
+        fail fast, and a batch with no live entries left skips the device
+        entirely. A deterministic batch failure bisects on request
+        boundaries — halves re-assemble at the same bucket and recurse;
+        a single-request batch that still fails is the poisoned one:
+        quarantined, with the stage exception as cause."""
+        now = time.perf_counter()
+        for req, *_ in plan.entries:
+            if req.deadline is not None and now > req.deadline \
+                    and not req.ticket.done:
+                self.stats["deadline_expired"] += 1
+                self._fail_request(req, DeadlineExceeded(
+                    f"request {req.rid} missed its deadline before dispatch "
+                    f"(expired {now - req.deadline:.3f}s ago waiting for a "
+                    "bucket) — failing fast instead of occupying one"))
+        if all(req.ticket.failed for req, *_ in plan.entries):
+            self.stats["skipped_batches"] += 1
+            return []
+        try:
+            return [(plan, self._dispatch_retry(plan, x))]
+        except Exception as exc:  # noqa: BLE001 — isolate, bisect, quarantine
+            self.stats["failed_batches"] += 1
+            reqs = list({id(r): r for r, *_ in plan.entries}.values())
+            if len(reqs) == 1:
+                req = reqs[0]
+                if not req.ticket.done:
+                    err = RequestQuarantinedError(
+                        f"request {req.rid} deterministically fails its "
+                        f"batch (bucket {plan.bucket}) — quarantined by "
+                        "bisection; batchmates completed separately")
+                    err.__cause__ = exc
+                    self.quarantined.append(req.rid)
+                    self.stats["quarantined"] += 1
+                    self._fail_request(req, err)
+                return []
+            results = []
+            mid = len(reqs) // 2
+            for part in (reqs[:mid], reqs[mid:]):
+                ids = {id(r) for r in part}
+                sub = self._subplan(
+                    plan, [e for e in plan.entries if id(e[0]) in ids])
+                sub, sx, err = self._assemble_safe(sub)
+                if err is not None:
+                    self._fail_plan(sub, err, "assembly (bisect)")
+                    continue
+                results += self._dispatch_safe(sub, sx)
+            return results
+
+    # ---------------------------------------------------------------- fetch
+
     def _finish(self, plan: BatchPlan, out) -> None:
         """D2H + delivery: one blocking fetch per batch, rows copied into
-        each ticket's buffer; padding rows are simply never read."""
-        host = np.asarray(out)
+        each ticket's buffer; padding rows are simply never read. A fetch
+        failure fails only this batch's tickets."""
+        try:
+            self._mark(f"fetch bucket={plan.bucket}")
+            host = np.asarray(out)
+            host = faults.fire("serve.fetch", tag=self._tag(plan),
+                               payload=host)
+        except Exception as exc:  # noqa: BLE001 — isolated per batch
+            self._fail_plan(plan, exc, "fetch")
+            return
         for req, lo, hi, offset in plan.entries:
             if req.ticket._deliver(lo, hi, host[offset:offset + (hi - lo)]):
                 self.stats["latencies_s"].append(req.ticket.latency_s)
+                with self._lock:
+                    self._open.pop(req.rid, None)
+
+    # -------------------------------------------------------------- failure
+
+    def _fail_request(self, req: Request, exc: BaseException) -> None:
+        with self._lock:
+            self._open.pop(req.rid, None)
+        if req.ticket._fail(exc):
+            self.stats["failed_tickets"] += 1
+
+    def _fail_plan(self, plan: BatchPlan, exc: BaseException,
+                   stage: str) -> None:
+        """Fail exactly this batch's tickets, the stage exception as cause."""
+        self.stats["failed_batches"] += 1
+        for req in {id(r): r for r, *_ in plan.entries}.values():
+            if req.ticket.done:
+                continue
+            err = RequestFailedError(
+                f"batch {stage} failed for request {req.rid} "
+                f"(bucket {plan.bucket}): {exc!r}")
+            err.__cause__ = exc
+            self._fail_request(req, err)
+
+    # ----------------------------------------------------- watchdog / drain
+
+    def _mark(self, label: str, budget_s: Optional[float] = None) -> None:
+        wd = self._wd
+        if wd is not None:
+            wd.mark(label, budget_s)
+
+    def _on_stall(self, label: str, silent: float) -> None:
+        """Soft watchdog abort: a device interaction went silent past the
+        stall budget (wedged backend — no exception will ever surface). Fail
+        every unresolved ticket so no waiter hangs; batches fetched before
+        the stall keep their delivered results."""
+        self._stalled = True
+        self.stats["stalls"] += 1
+        err = EngineStalledError(
+            f"engine made no progress for {silent:.1f}s after {label!r} — "
+            "wedged backend; in-flight and queued tickets failed, results "
+            "fetched before the stall stand")
+        with self._lock:
+            open_reqs = list(self._open.values())
+        for req in open_reqs:
+            self._fail_request(req, err)
+
+    def drain(self, timeout: Optional[float] = None) -> dict:
+        """Graceful shutdown: stop admission (``submit`` raises
+        :class:`EngineClosedError`), let an active :meth:`run` flush its
+        in-flight batches, then deterministically fail everything still
+        queued. Returns the final health snapshot."""
+        with self._lock:
+            self._closed = True
+        self._idle.wait(timeout)
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req in pending:
+            self._fail_request(req, EngineClosedError(
+                f"engine drained with request {req.rid} still queued"))
+        return self.health()
+
+    def health(self) -> dict:
+        """Live health snapshot (also rendered into Ticket timeout
+        messages): queue/engine state, failure counters, and realized fault
+        injections by site."""
+        with self._lock:
+            depth = len(self._pending)
+            open_n = len(self._open)
+        s = self.stats
+        return {
+            "queue_depth": depth,
+            "open_tickets": open_n,
+            "running": self._running,
+            "closed": self._closed,
+            "stalled": self._stalled,
+            "compiles": s["compiles"],
+            "dispatches": s["dispatches"],
+            "retries": s["retries"],
+            "failed_batches": s["failed_batches"],
+            "failed_tickets": s["failed_tickets"],
+            "quarantined": s["quarantined"],
+            "deadline_expired": s["deadline_expired"],
+            "rejected": s["rejected"],
+            "skipped_batches": s["skipped_batches"],
+            "stalls": s["stalls"],
+            "faults_by_site": faults.snapshot()["by_site"],
+        }
 
     # ------------------------------------------------------------------ run
 
     def run(self) -> dict:
         """Drain the queue: plan → assemble (background) → dispatch → fetch,
         pipelined. Returns a report for this drain (throughput over real
-        rows — padding is excluded from img/s by construction)."""
+        rows — padding is excluded from img/s by construction). Failures
+        never escape a batch: see the module docstring's isolation story."""
         t0 = time.perf_counter()
         compiles0 = self.stats["compiles"]
+        counters0 = {k: self.stats[k] for k in
+                     ("retries", "failed_tickets", "quarantined")}
         rows = padded = batches = 0
-        completed: list[float] = []
         n_lat0 = len(self.stats["latencies_s"])
-        while True:
-            with self._lock:
-                pending, self._pending = self._pending, []
-            if not pending:
-                break
-            plans = plan_batches(pending, self.buckets)
-            inflight: deque = deque()
-            for plan, x in device_prefetch(plans, lambda p: self._assemble(p),
-                                           depth=self.prefetch_depth):
-                inflight.append((plan, self._dispatch(plan, x)))
-                rows += plan.rows
-                padded += plan.padded_rows
-                batches += 1
-                while len(inflight) > self.inflight:
+        self._stalled = False
+        self._running = True
+        self._idle.clear()
+        wd = None
+        if self.stall_s > 0:
+            wd = StallWatchdog(self.stall_s, exit_code=None,
+                               on_abort=self._on_stall, name="engine")
+            self._wd = wd
+            wd.start()
+        try:
+            while not self._stalled:
+                with self._lock:
+                    pending, self._pending = self._pending, []
+                    closed = self._closed
+                if closed:
+                    for req in pending:
+                        self._fail_request(req, EngineClosedError(
+                            f"engine drained with request {req.rid} still "
+                            "queued"))
+                    break
+                if not pending:
+                    break
+                live = self._admit(pending)
+                if not live:
+                    continue
+                self._mark(f"plan {len(live)} requests")
+                plans = plan_batches(live, self.buckets)
+                inflight: deque = deque()
+                for plan, x, err in device_prefetch(
+                        plans, self._assemble_safe,
+                        depth=self.prefetch_depth):
+                    if self._stalled:
+                        break
+                    if err is not None:
+                        self._fail_plan(plan, err, "assembly")
+                        continue
+                    for item in self._dispatch_safe(plan, x):
+                        inflight.append(item)
+                        batches += 1
+                        rows += item[0].rows
+                        padded += item[0].padded_rows
+                    while len(inflight) > self.inflight:
+                        self._finish(*inflight.popleft())
+                while inflight:
                     self._finish(*inflight.popleft())
-            while inflight:
-                self._finish(*inflight.popleft())
+        finally:
+            self._running = False
+            if wd is not None:
+                wd.done()
+                self._wd = None
+            self._idle.set()
         wall = time.perf_counter() - t0
         completed = self.stats["latencies_s"][n_lat0:]
         return {
@@ -345,7 +669,24 @@ class Engine:
             "latency": latency_summary(completed),
             "compiles": self.stats["compiles"] - compiles0,
             "max_queue_depth": self.stats["max_queue_depth"],
+            "stalled": self._stalled,
+            **{k: self.stats[k] - v0 for k, v0 in counters0.items()},
         }
+
+    def _admit(self, pending) -> list:
+        """Plan-time deadline gate: expired requests fail fast HERE, before
+        they cost a bucket slot or an assembly."""
+        now = time.perf_counter()
+        live = []
+        for req in pending:
+            if req.deadline is not None and now > req.deadline:
+                self.stats["deadline_expired"] += 1
+                self._fail_request(req, DeadlineExceeded(
+                    f"request {req.rid} missed its deadline while queued "
+                    f"(expired {now - req.deadline:.3f}s before planning)"))
+            else:
+                live.append(req)
+        return live
 
 
 def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig):

@@ -25,7 +25,8 @@ from ddim_cold_tpu.utils.platform import enable_compile_cache
 def warmup(engine, configs: Sequence[SamplerConfig],
            buckets: Optional[Sequence[int]] = None, *,
            persistent_cache: bool = True,
-           cache_dir: Optional[str] = None) -> dict:
+           cache_dir: Optional[str] = None,
+           tolerate_errors: bool = False) -> dict:
     """Compile every (config, bucket) program the engine may dispatch.
 
     ``configs`` is the exact set of :class:`SamplerConfig` the deployment
@@ -33,17 +34,29 @@ def warmup(engine, configs: Sequence[SamplerConfig],
     and caught by the guard test). Returns a report with the number of new
     compiles, total resident programs, and the persistent-cache directory
     (None when disabled or the running JAX lacks the feature).
+
+    ``tolerate_errors=True`` keeps warming the remaining programs when one
+    compile fails (degraded startup beats no startup: a config whose compile
+    is broken will fail at its own dispatch, not take the deployment down);
+    the per-program exceptions land in ``report["errors"]``.
     """
     buckets = tuple(buckets) if buckets is not None else engine.buckets
     active_dir = enable_compile_cache(cache_dir) if persistent_cache else None
     before = engine.stats["compiles"]
+    errors: dict = {}
     for config in configs:
         for bucket in buckets:
-            engine.ensure_program(config, bucket)
+            try:
+                engine.ensure_program(config, bucket)
+            except Exception as exc:  # noqa: BLE001 — optionally isolated
+                if not tolerate_errors:
+                    raise
+                errors[(config, bucket)] = exc
     return {
         "new_compiles": engine.stats["compiles"] - before,
         "programs": len(engine._programs),
         "buckets": buckets,
         "configs": len(set(configs)),
         "cache_dir": active_dir,
+        "errors": errors,
     }

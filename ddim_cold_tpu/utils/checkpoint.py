@@ -236,10 +236,17 @@ def save_checkpoint(path: str, tree) -> None:
     write, so a crash mid-write would lose the only resume point. Multi-host
     saves go directly through orbax's own collective commit protocol (a
     per-process directory swap on a shared fs would race).
+
+    The ``ckpt.save`` fault site fires at every crash window of the
+    single-host sequence (pre-write / post-write / mid-swap / post-swap) —
+    the crash-window tests kill the save at each and assert a loadable
+    checkpoint always survives (``recover_swap`` + restore).
     """
     import shutil
 
     import orbax.checkpoint as ocp
+
+    from ddim_cold_tpu.utils import faults
 
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
@@ -251,10 +258,14 @@ def save_checkpoint(path: str, tree) -> None:
     for d in (tmp, old):  # true leftovers (post-recovery) from a crashed save
         if os.path.isdir(d):
             shutil.rmtree(d)
+    faults.fire("ckpt.save", tag="window:pre-write|")
     ckptr.save(tmp, _to_host(tree), force=True)
+    faults.fire("ckpt.save", tag="window:post-write|")
     if os.path.isdir(path):
         os.rename(path, old)
+    faults.fire("ckpt.save", tag="window:mid-swap|")
     os.rename(tmp, path)
+    faults.fire("ckpt.save", tag="window:post-swap|")
     if os.path.isdir(old):
         shutil.rmtree(old)
 

@@ -35,9 +35,16 @@ class StallWatchdog:
     ``budget_s`` on a mark stretches the deadline for the single window
     AFTER it — known-long silent operations (a first Mosaic compile at
     N=2501 exceeds any sane default) must not be killed as wedged.
+
+    ``exit_code=None`` selects SOFT mode for long-running in-process hosts
+    (the serving engine): on stall the watchdog calls ``on_abort`` once and
+    stops, WITHOUT ``os._exit`` — the abort hook unblocks waiters (fails
+    their tickets) while the wedged native call stays parked on its own
+    thread. One-shot evidence scripts keep the hard default: their main
+    thread IS the wedged one, so only process death frees anything.
     """
 
-    def __init__(self, stall_s: float, *, exit_code: int = 3,
+    def __init__(self, stall_s: float, *, exit_code: Optional[int] = 3,
                  on_abort: Optional[Callable[[str, float], None]] = None,
                  name: str = "watchdog"):
         self.stall_s = float(stall_s)
@@ -82,4 +89,7 @@ class StallWatchdog:
                     except Exception as e:  # noqa: BLE001 — abort must abort
                         print(f"[{self.name}] on_abort failed: {e!r}",
                               file=sys.stderr, flush=True)
+                if self.exit_code is None:  # soft mode: one-shot, no exit
+                    self.done()
+                    return
                 os._exit(self.exit_code)

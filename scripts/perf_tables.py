@@ -123,6 +123,20 @@ def render(path: str) -> str:
                 f"{sq.get('param_bytes')} → {sq.get('param_bytes_quant')} · "
                 f"compiles after warmup {sq.get('compiles_after_warmup')}")
 
+    fl = sub.get("faults")
+    if fl:
+        lines.append("")
+        lines.append(
+            f"**robustness:** disarmed {fl.get('clean_img_per_sec')} img/s"
+            + (f" ({fl['disarmed_vs_serving']}× plain serving)"
+               if fl.get("disarmed_vs_serving") is not None else "")
+            + f" · chaos {fl.get('chaos_img_per_sec')} img/s "
+              f"({fl.get('degraded_ratio')}× disarmed) under "
+              f"{fl.get('injected')} injections {fl.get('by_site')} · "
+              f"retries {fl.get('retries')} · quarantined "
+              f"{fl.get('quarantined')} · compiles after warmup "
+              f"{fl.get('compiles_after_warmup')}")
+
     for key, label in (("cached_quality_64px", "cached quality 64px"),
                        ("quant_quality_64px", "w8a16 quality 64px"),
                        ("quant_cached_quality_64px",

@@ -48,6 +48,30 @@ def test_bench_serving_smoke_record(capsys):
     assert srv["max_queue_depth"] >= 1
 
 
+def test_bench_faults_smoke_record(capsys):
+    """The --faults robustness leg: a disarmed drain (zero
+    compiles-after-warmup, the zero-overhead guarantee) then the fixed
+    seeded chaos schedule — the record must carry degraded-mode throughput
+    and the recovery counters the driver compares round over round."""
+    import bench
+
+    bench.main(["--smoke", "--cpu", "--steps", "3", "--batch", "4",
+                "--skip-sampler", "--no-ksweep", "--faults"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    fl = rec["submetrics"]["faults"]
+    assert fl["compiles_after_warmup"] == 0  # clean AND chaos drains
+    assert fl["warmup_new_compiles"] >= 1
+    assert np.isfinite(fl["clean_img_per_sec"]) and fl["clean_img_per_sec"] > 0
+    assert np.isfinite(fl["chaos_img_per_sec"]) and fl["chaos_img_per_sec"] > 0
+    assert fl["degraded_ratio"] > 0
+    # the fixed schedule always quarantines its one poisoned request, and
+    # the permanent fault fired at least once to cause it
+    assert fl["quarantined"] == 1 and fl["failed_tickets"] == 1
+    assert fl["injected"] >= 1 and fl["by_site"]
+    assert fl["rows"] > 0
+
+
 def test_bench_quant_smoke_record(capsys):
     """The --quant 64px leg must record both dequant-matmul modes with
     paired drift + the param-byte saving, and stamp quant_rev next to

@@ -1,0 +1,283 @@
+"""Fault-injection registry (utils/faults.py): determinism, replay, scoping,
+the env grammar — and the non-serve fault sites (``ckpt.save`` crash windows,
+``data.next``).
+
+The registry's whole value is that chaos is REPRODUCIBLE: same specs + same
+call order → same injections, and a realized plan replays itself exactly.
+These tests pin that, then use the ``ckpt.save`` site to kill
+``save_checkpoint`` inside every crash window and assert the two-rename swap
+never loses the last loadable checkpoint.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ddim_cold_tpu.utils import faults
+from ddim_cold_tpu.utils.faults import (FaultSpec, PermanentFault,
+                                        TransientFault, parse_specs)
+
+pytestmark = pytest.mark.usefixtures("clean_faults")
+
+
+@pytest.fixture()
+def clean_faults():
+    """Chaos must not leak between tests: every scope exits via the context
+    manager, so here we only ASSERT the invariant rather than repair it."""
+    assert not faults.active(), "a previous test leaked an armed fault scope"
+    yield
+    assert not faults.active(), "this test leaked an armed fault scope"
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_disarmed_fire_is_identity():
+    buf = np.arange(6.0)
+    out = faults.fire("serve.dispatch", tag="bucket:8|", payload=buf)
+    assert out is buf  # not even a copy on the fast path
+    assert faults.current_plan() is None
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSpec("serve.nope")
+    with pytest.raises(ValueError, match="kind"):
+        FaultSpec("serve.dispatch", "explode")
+    with pytest.raises(ValueError, match="rate"):
+        FaultSpec("serve.dispatch", rate=1.5)
+
+
+def _drive(spec, calls=40, site="serve.dispatch"):
+    """Fire ``site`` ``calls`` times under ``spec``; return the call indices
+    that raised."""
+    hits = []
+    with faults.inject(spec):
+        for i in range(calls):
+            try:
+                faults.fire(site, tag=f"req:{i}|")
+            except (TransientFault, PermanentFault):
+                hits.append(i)
+    return hits
+
+
+def test_seeded_schedule_is_deterministic():
+    spec = FaultSpec("serve.dispatch", "transient", rate=0.3, seed=7)
+    first = _drive(spec)
+    assert first, "rate=0.3 over 40 calls must fire at least once"
+    for _ in range(3):  # scope exit resets counters: exact repetition
+        assert _drive(spec) == first
+    # a different seed is a different schedule
+    assert _drive(FaultSpec("serve.dispatch", "transient",
+                            rate=0.3, seed=8)) != first
+
+
+def test_match_restricts_to_tagged_calls():
+    spec = FaultSpec("serve.dispatch", "permanent", match="req:3|")
+    assert _drive(spec, calls=12) == [3]  # and NOT req:33 etc. (trailing |)
+
+
+def test_max_fires_caps_injections():
+    spec = FaultSpec("serve.dispatch", "transient", rate=1.0, max_fires=2)
+    assert _drive(spec, calls=10) == [0, 1]
+
+
+def test_at_overrides_dice():
+    spec = FaultSpec("serve.dispatch", "transient", at=(2, 5))
+    assert _drive(spec, calls=10) == [2, 5]
+
+
+def test_latency_sleeps_and_records():
+    spec = FaultSpec("serve.dispatch", "latency", latency_s=0.15, max_fires=1)
+    with faults.inject(spec) as plan:
+        t0 = time.perf_counter()
+        faults.fire("serve.dispatch")
+        dt = time.perf_counter() - t0
+    assert dt >= 0.15
+    assert plan.realized[0]["kind"] == "latency"
+
+
+def test_corrupt_flips_one_element_copy_not_caller():
+    buf = np.zeros(32, np.float32)
+    spec = FaultSpec("serve.fetch", "corrupt", seed=5, max_fires=1)
+    with faults.inject(spec) as plan:
+        out = faults.fire("serve.fetch", payload=buf)
+    assert np.isnan(out).sum() == 1
+    assert not np.isnan(buf).any()  # caller's buffer untouched
+    idx = plan.realized[0]["detail"]["index"]
+    assert np.isnan(out[idx])
+    # int payloads corrupt too (saturate, not NaN)
+    ibuf = np.zeros(8, np.int32)
+    with faults.inject(FaultSpec("serve.fetch", "corrupt", seed=5)):
+        iout = faults.fire("serve.fetch", payload=ibuf)
+    assert (iout == np.iinfo(np.int32).max).sum() == 1
+
+
+def test_plan_records_and_replays_exactly():
+    spec = FaultSpec("serve.dispatch", "transient", rate=0.3, seed=7)
+    with faults.inject(spec) as plan:
+        hits = []
+        for i in range(30):
+            try:
+                faults.fire("serve.dispatch", tag=f"req:{i}|")
+            except TransientFault:
+                hits.append(i)
+        realized = [(r["site"], r["call"], r["kind"]) for r in plan.realized]
+        replay_specs = plan.replay()
+    assert [c for _, c, _ in realized] == hits
+    # the replay specs re-fire at exactly the same call indices — dice retired
+    assert replay_specs[0].at == tuple(hits)
+    assert _drive(replay_specs[0], calls=30) == hits
+    assert plan.by_site() == {"serve.dispatch": len(hits)}
+
+
+def test_scopes_stack_and_reset():
+    outer = FaultSpec("serve.dispatch", "transient", at=(1,))
+    inner = FaultSpec("serve.fetch", "transient", at=(0,))
+    with faults.inject(outer) as plan:
+        faults.fire("serve.dispatch")  # call 0: no hit
+        with faults.inject(inner):
+            assert faults.current_plan() is plan  # shared plan, not nested
+            with pytest.raises(TransientFault):
+                faults.fire("serve.fetch")
+        with pytest.raises(TransientFault):
+            faults.fire("serve.dispatch")  # call 1: counters NOT reset by
+            # the inner scope's exit
+        assert plan.by_site() == {"serve.fetch": 1, "serve.dispatch": 1}
+    assert faults.current_plan() is None  # last scope out: full reset
+
+
+def test_snapshot_shape():
+    assert faults.snapshot() == {"armed": 0, "injected": 0, "by_site": {}}
+    with faults.inject(FaultSpec("serve.dispatch", "transient", at=(0,))):
+        with pytest.raises(TransientFault):
+            faults.fire("serve.dispatch")
+        snap = faults.snapshot()
+    assert snap["armed"] == 1 and snap["injected"] == 1
+    assert snap["by_site"] == {"serve.dispatch": 1}
+
+
+# ------------------------------------------------------------- env grammar
+
+
+def test_parse_specs_grammar_round_trip():
+    specs = parse_specs(
+        "serve.dispatch:transient:rate=0.2,seed=7;"
+        "serve.fetch:latency:latency_s=0.5;"
+        "ckpt.save:permanent:match=window:mid-swap|,max_fires=1;"
+        "data.next:corrupt:at=0+3")
+    assert [s.site for s in specs] == ["serve.dispatch", "serve.fetch",
+                                      "ckpt.save", "data.next"]
+    assert specs[0].rate == 0.2 and specs[0].seed == 7
+    assert specs[1].latency_s == 0.5
+    assert specs[2].match == "window:mid-swap|" and specs[2].max_fires == 1
+    assert specs[3].at == (0, 3)
+    with pytest.raises(ValueError, match="site:kind"):
+        parse_specs("serve.dispatch")
+    with pytest.raises(ValueError, match="unknown fault spec key"):
+        parse_specs("serve.dispatch:transient:boom=1")
+
+
+def test_env_var_arms_in_subprocess():
+    """The env path is process-lifetime state — exercised in a subprocess so
+    this process's registry stays clean."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = """
+import sys; sys.path.insert(0, {repo!r})
+from ddim_cold_tpu.utils import faults
+try:
+    faults.fire("serve.dispatch")
+except faults.TransientFault:
+    print("armed-from-env")
+print("injected", faults.snapshot()["injected"])
+""".format(repo=repo)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60, env=dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            DDIM_COLD_FAULTS="serve.dispatch:transient:at=0"))
+    assert proc.returncode == 0, proc.stderr
+    assert "armed-from-env" in proc.stdout
+    assert "injected 1" in proc.stdout
+
+
+# ---------------------------------------------- ckpt.save crash windows
+
+
+#: every window the two-rename swap can die in (the tags save_checkpoint
+#: fires at, in sequence)
+CKPT_WINDOWS = ("pre-write", "post-write", "mid-swap", "post-swap")
+
+
+@pytest.mark.parametrize("window", CKPT_WINDOWS)
+def test_ckpt_save_crash_window_never_loses_checkpoint(tmp_path, window):
+    """Kill the save inside each crash window: after recover_swap, a
+    loadable checkpoint ALWAYS survives — v1 (crash before the new data
+    committed) or v2 (crash after) — never a torn state."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    p = str(tmp_path / "state.ckpt")
+    v1 = {"a": np.arange(3), "epoch": np.asarray(1)}
+    v2 = {"a": np.arange(3) + 10, "epoch": np.asarray(2)}
+    ckpt.save_checkpoint(p, v1)
+    with faults.inject(FaultSpec("ckpt.save", "permanent",
+                                 match=f"window:{window}|")):
+        with pytest.raises(PermanentFault):
+            ckpt.save_checkpoint(p, v2)
+    ckpt.recover_swap(p)  # what the trainer's resume path runs
+    got = ckpt.restore_checkpoint(p)
+    assert int(got["epoch"]) in (1, 2), "torn checkpoint"
+    want = v1 if int(got["epoch"]) == 1 else v2
+    np.testing.assert_array_equal(got["a"], want["a"])
+    # the NEXT save must heal leftovers and fully succeed
+    v3 = {"a": np.arange(3) + 20, "epoch": np.asarray(3)}
+    ckpt.save_checkpoint(p, v3)
+    np.testing.assert_array_equal(ckpt.restore_checkpoint(p)["a"], v3["a"])
+    assert not os.path.isdir(p + ".writing") and not os.path.isdir(p + ".old")
+
+
+def test_ckpt_save_transient_window_heals_on_retry(tmp_path):
+    """A transient fault mid-swap (the realistic NFS hiccup): the very next
+    save_checkpoint call recovers the swap itself and overwrites cleanly."""
+    from ddim_cold_tpu.utils import checkpoint as ckpt
+
+    p = str(tmp_path / "state.ckpt")
+    ckpt.save_checkpoint(p, {"a": np.arange(3)})
+    with faults.inject(FaultSpec("ckpt.save", "transient",
+                                 match="window:mid-swap|", max_fires=1)):
+        with pytest.raises(TransientFault):
+            ckpt.save_checkpoint(p, {"a": np.arange(4)})
+        ckpt.save_checkpoint(p, {"a": np.arange(5)})  # retry inside scope
+    np.testing.assert_array_equal(ckpt.restore_checkpoint(p)["a"],
+                                  np.arange(5))
+
+
+# ------------------------------------------------------------- data.next
+
+
+def test_data_next_site_fires_in_loader():
+    from ddim_cold_tpu.data.loader import ShardedLoader
+
+    class Toy:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            x = np.full((4, 4, 3), float(i), np.float32)
+            return x, x, i
+
+    loader = ShardedLoader(Toy(), batch_size=4, shuffle=False,
+                           num_threads=1)
+    with faults.inject(FaultSpec("data.next", "transient", at=(1,))):
+        it = iter(loader)
+        next(it)  # batch 0 fine
+        with pytest.raises(TransientFault):
+            next(it)  # batch 1 killed — surfaces at the consumer
+    # disarmed: the loader iterates clean (threaded path too)
+    loader2 = ShardedLoader(Toy(), batch_size=4, shuffle=False,
+                            num_threads=2)
+    assert sum(1 for _ in loader2) == 2

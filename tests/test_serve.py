@@ -11,6 +11,7 @@ tolerance as the sampler's own mesh tests)."""
 import os
 import subprocess
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +22,7 @@ from ddim_cold_tpu import serve
 from ddim_cold_tpu.models import DiffusionViT
 from ddim_cold_tpu.ops import sampling
 from ddim_cold_tpu.serve.batching import Request, cover_rows, plan_batches, select_bucket
+from ddim_cold_tpu.utils import faults
 
 TINY = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
             num_heads=4, total_steps=2000)
@@ -261,8 +263,15 @@ def test_engine_validation_and_ticket_timeout(model_and_params):
     with pytest.raises(ValueError, match="buckets"):
         serve.Engine(model, params, buckets=())
     ticket = eng.submit(seed=0, n=2)
-    with pytest.raises(TimeoutError, match="Engine.run"):
-        ticket.result(timeout=0.01)  # never ran — must not hang forever
+    # never ran — must not hang forever, and the timeout carries the engine
+    # health snapshot (an ops page beats "did Engine.run() run?")
+    with pytest.raises(TimeoutError, match="queue_depth"):
+        ticket.result(timeout=0.01)
+    with pytest.raises(TimeoutError, match="engine health"):
+        ticket.exception(timeout=0.01)
+    # a BARE ticket (no engine attached) keeps the did-run hint
+    with pytest.raises(TimeoutError, match="no engine attached"):
+        serve.Ticket(1).result(timeout=0.01)
 
 
 def test_engine_mesh_sharded(model_and_params):
@@ -297,3 +306,236 @@ def test_check_compile_cache_script():
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert ("PASS" in proc.stdout) or ("SKIP" in proc.stdout), proc.stdout
+
+
+# ------------------------------------------------------------------ chaos
+#
+# Failure isolation under deterministic fault injection (utils/faults.py).
+# The liveness contract every case pins: NO ticket ever blocks forever —
+# each resolves to its rows or to a typed exception — and the engine keeps
+# serving after the chaos scope closes, with ZERO new compiles (recovery
+# re-packs at the warmed buckets).
+
+
+def _all_resolved(tickets, timeout=30):
+    """Every ticket resolves (rows or error) within timeout — the no-hung-
+    ticket guarantee. Returns the failures."""
+    errs = []
+    for t in tickets:
+        exc = t.exception(timeout=timeout)  # raises TimeoutError on a hang
+        if exc is not None:
+            errs.append(exc)
+    return errs
+
+
+def test_chaos_transient_dispatch_kill(model_and_params, warmed):
+    """Kill a seeded ≥20% of dispatches with the retryable fault class: the
+    backoff-retry path absorbs every hit, ALL tickets complete, and every
+    one is bitwise-equal to the direct sampler."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    compiles = eng.stats["compiles"]
+    retries0 = eng.stats["retries"]
+    reqs = [(s, n) for s, n in zip(range(200, 210), [3, 5, 2, 8, 1, 4, 6, 2, 7, 3])]
+    spec = faults.FaultSpec("serve.dispatch", "transient", rate=0.35, seed=11)
+    with faults.inject(spec) as plan:
+        tickets = {s: eng.submit(seed=s, n=n, config=cfg) for s, n in reqs}
+        report = eng.run()
+        injected = len(plan.realized)
+    dispatch_calls = report["batches"] + injected  # every fire = one attempt
+    assert injected >= 0.2 * dispatch_calls, (injected, dispatch_calls)
+    assert _all_resolved(list(tickets.values())) == []
+    assert eng.stats["retries"] - retries0 == injected
+    for s, n in reqs:
+        np.testing.assert_array_equal(tickets[s].result(timeout=5),
+                                      _direct(model, params, s, n))
+    assert eng.stats["compiles"] == compiles  # recovery never compiles
+
+
+def test_chaos_every_serve_site(model_and_params, warmed):
+    """Faults at EVERY serve.* pipeline site at once (assemble raises,
+    dispatch raises transient, fetch raises): each batch fails only itself,
+    non-quarantined survivors are bitwise, nothing hangs, and the engine
+    serves a clean follow-up drain."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    compiles = eng.stats["compiles"]
+    reqs = [(s, n) for s, n in zip(range(300, 312),
+                                   [2, 3, 1, 4, 2, 5, 3, 2, 1, 6, 2, 3])]
+    with faults.inject(
+            faults.FaultSpec("serve.assemble", "permanent", rate=0.25, seed=2),
+            faults.FaultSpec("serve.dispatch", "transient", rate=0.3, seed=3),
+            faults.FaultSpec("serve.fetch", "permanent", rate=0.25, seed=4),
+    ) as plan:
+        tickets = {s: eng.submit(seed=s, n=n, config=cfg) for s, n in reqs}
+        eng.run()
+        assert len(plan.realized) > 0
+        assert set(plan.by_site()) <= {"serve.assemble", "serve.dispatch",
+                                       "serve.fetch"}
+    errs = _all_resolved(list(tickets.values()))
+    for e in errs:  # typed failures only, each carrying the injected cause
+        assert isinstance(e, serve.RequestFailedError)
+        assert isinstance(e.__cause__, faults.FaultError)
+    for s, n in reqs:  # survivors keep their bits
+        if not tickets[s].failed:
+            np.testing.assert_array_equal(tickets[s].result(timeout=5),
+                                          _direct(model, params, s, n))
+    assert eng.stats["compiles"] == compiles
+    # chaos scope closed: the engine serves clean
+    t = eng.submit(seed=399, n=3, config=cfg)
+    eng.run()
+    np.testing.assert_array_equal(t.result(timeout=5),
+                                  _direct(model, params, 399, 3))
+    assert eng.stats["compiles"] == compiles
+
+
+def test_chaos_bisection_quarantines_poisoned_request(model_and_params,
+                                                      warmed):
+    """A request that deterministically fails ANY batch containing it is
+    bisected out: only IT fails (RequestQuarantinedError, injected fault as
+    cause), every innocent batchmate completes bitwise, and recovery stays
+    on the warmed programs."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    compiles = eng.stats["compiles"]
+    quarantined0 = eng.stats["quarantined"]
+    tickets = {}
+    poison_rid = eng._next_rid + 2  # the third of the five submits below
+    with faults.inject(faults.FaultSpec("serve.dispatch", "permanent",
+                                        match=f"req:{poison_rid}|")):
+        for i, (s, n) in enumerate(zip(range(410, 415), [2, 1, 2, 1, 2])):
+            tickets[s] = eng.submit(seed=s, n=n, config=cfg)
+        eng.run()
+    errs = _all_resolved(list(tickets.values()))
+    assert len(errs) == 1 and isinstance(errs[0], serve.RequestQuarantinedError)
+    assert isinstance(errs[0].__cause__, faults.PermanentFault)
+    assert eng.stats["quarantined"] - quarantined0 == 1
+    assert poison_rid in eng.quarantined
+    for s, n in zip(range(410, 415), [2, 1, 2, 1, 2]):
+        if not tickets[s].failed:
+            np.testing.assert_array_equal(tickets[s].result(timeout=5),
+                                          _direct(model, params, s, n))
+    assert sum(1 for s in range(410, 415) if tickets[s].failed) == 1
+    assert eng.stats["compiles"] == compiles  # bisection repacks, no compile
+
+
+def test_chaos_fetch_corrupt_is_detectable(model_and_params, warmed):
+    """A corrupt injection at the fetch site lands exactly one NaN in the
+    delivered buffer (detectability: a checksum/validation layer upstream
+    would catch it) and records which element in the plan."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    with faults.inject(faults.FaultSpec("serve.fetch", "corrupt", seed=5,
+                                        max_fires=1)) as plan:
+        t = eng.submit(seed=420, n=4, config=cfg)
+        eng.run()
+        out = t.result(timeout=5)
+    assert int(np.isnan(out).sum()) <= 1  # ≤: the flip may land in padding
+    assert plan.realized[0]["detail"]["index"] >= 0
+    clean = _direct(model, params, 420, 4)
+    mism = out != clean
+    assert mism.sum() <= 1  # exactly the flipped element differs
+
+
+def test_deadline_enforced_at_plan_and_dispatch(model_and_params, warmed):
+    """deadline_s=0 expires in the queue (plan-time gate); a live deadline
+    that lapses during a slow assembly expires at the dispatch gate and the
+    all-expired batch skips the device entirely."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    t0 = eng.submit(seed=430, n=2, config=cfg, deadline_s=0.0)
+    time.sleep(0.01)
+    eng.run()
+    assert isinstance(t0.exception(timeout=5), serve.DeadlineExceeded)
+    skipped0 = eng.stats["skipped_batches"]
+    t1 = eng.submit(seed=431, n=4, config=cfg, deadline_s=0.1)
+    with faults.inject(faults.FaultSpec("serve.assemble", "latency",
+                                        latency_s=0.3, max_fires=1)):
+        eng.run()
+    assert isinstance(t1.exception(timeout=5), serve.DeadlineExceeded)
+    assert eng.stats["skipped_batches"] == skipped0 + 1
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit(seed=0, n=1, config=cfg, deadline_s=-1)
+
+
+def test_bounded_queue_rejects_on_overload(model_and_params):
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,), max_queue=2)
+    cfg = serve.SamplerConfig(k=K)
+    a = eng.submit(seed=0, n=1, config=cfg)
+    b = eng.submit(seed=1, n=1, config=cfg)
+    with pytest.raises(serve.QueueFullError, match="max_queue=2"):
+        eng.submit(seed=2, n=1, config=cfg)
+    assert eng.stats["rejected"] == 1
+    assert eng.health()["queue_depth"] == 2
+    # drain (without ever running): queued tickets fail deterministically
+    health = eng.drain(timeout=1)
+    assert health["closed"] and health["queue_depth"] == 0
+    for t in (a, b):
+        assert isinstance(t.exception(timeout=5), serve.EngineClosedError)
+    with pytest.raises(serve.EngineClosedError):
+        eng.submit(seed=3, n=1, config=cfg)
+    with pytest.raises(ValueError, match="max_queue"):
+        serve.Engine(model, params, buckets=(4,), max_queue=0)
+
+
+def test_stall_watchdog_fails_tickets_not_process(model_and_params):
+    """A wedged dispatch (injected 1.2s silence against a 0.3s stall budget)
+    trips the SOFT watchdog: in-flight tickets fail with EngineStalledError,
+    run() returns (stalled flagged) — the process survives, nothing hangs."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,), stall_s=0.3)
+    cfg = serve.SamplerConfig(k=K)
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    t = eng.submit(seed=440, n=4, config=cfg)
+    with faults.inject(faults.FaultSpec("serve.dispatch", "latency",
+                                        latency_s=1.2, max_fires=1)):
+        report = eng.run()
+    assert report["stalled"]
+    assert isinstance(t.exception(timeout=5), serve.EngineStalledError)
+    assert eng.stats["stalls"] == 1
+    assert eng.health()["stalled"]
+    # the engine recovers on the next drain (fresh watchdog per run)
+    t2 = eng.submit(seed=441, n=2, config=cfg)
+    report2 = eng.run()
+    assert not report2["stalled"]
+    np.testing.assert_array_equal(t2.result(timeout=5),
+                                  _direct(model, params, 441, 2))
+
+
+def test_warmup_tolerate_errors(model_and_params):
+    """Degraded startup: a failing compile is recorded, the rest of the
+    programs warm, and strict mode still raises."""
+    model, params = model_and_params
+    cfg = serve.SamplerConfig(k=K)
+    eng = serve.Engine(model, params, buckets=(4, 8))
+    with faults.inject(faults.FaultSpec("serve.compile", "permanent",
+                                        max_fires=1)):
+        with pytest.raises(faults.PermanentFault):
+            serve.warmup(eng, [cfg], persistent_cache=False)
+        report = serve.warmup(eng, [cfg], persistent_cache=False,
+                              tolerate_errors=True)
+    assert len(report["errors"]) == 0  # max_fires spent on the strict call
+    eng2 = serve.Engine(model, params, buckets=(4, 8))
+    with faults.inject(faults.FaultSpec("serve.compile", "permanent",
+                                        max_fires=1)):
+        report = serve.warmup(eng2, [cfg], persistent_cache=False,
+                              tolerate_errors=True)
+    assert len(report["errors"]) == 1
+    assert report["new_compiles"] == 1  # the other program warmed anyway
+
+
+def test_disarmed_serving_is_bitwise_and_compile_free(model_and_params,
+                                                      warmed):
+    """The zero-overhead-disarmed contract: after any amount of chaos, a
+    disarmed drain is byte-identical to the direct sampler and triggers no
+    compiles — the fault hooks cost a flag check on the fast path."""
+    model, params = model_and_params
+    eng, cfg = warmed
+    assert not faults.active()
+    compiles = eng.stats["compiles"]
+    t = eng.submit(seed=450, n=6, config=cfg)
+    eng.run()
+    np.testing.assert_array_equal(t.result(timeout=5),
+                                  _direct(model, params, 450, 6))
+    assert eng.stats["compiles"] == compiles

@@ -86,3 +86,24 @@ print("no thread, no abort")
 """
     proc, _ = run_script(body, repo)
     assert proc.returncode == 0
+
+
+def test_soft_mode_calls_abort_without_exit(repo):
+    """exit_code=None (the serving engine's mode): on stall the watchdog
+    fires on_abort ONCE, stops itself, and the process lives on — waiters
+    get failed by the hook instead of the host dying. In-process test: no
+    os._exit to dodge."""
+    from ddim_cold_tpu.utils.watchdog import StallWatchdog
+
+    calls = []
+    wd = StallWatchdog(0.2, exit_code=None,
+                       on_abort=lambda label, silent: calls.append(label),
+                       name="soft").start()
+    wd.mark("wedged-op")
+    deadline = time.time() + 10
+    while not calls and time.time() < deadline:
+        time.sleep(0.05)
+    assert calls == ["wedged-op"]
+    time.sleep(0.3)  # watchdog stopped itself: no second abort, no exit
+    assert calls == ["wedged-op"]
+    assert wd._state["done"]  # the thread retired after the one abort
