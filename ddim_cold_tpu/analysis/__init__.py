@@ -1,0 +1,22 @@
+"""graftcheck — static analysis proving the repo's TPU invariants.
+
+PRs 1–4 established invariants the test suite can only sample at a few
+shapes: zero serve-time recompiles, live buffer donation, the
+bf16-trunk/f32-accumulate dtype policy, full sharding-spec coverage. This
+package checks them *statically* on every commit by abstractly tracing the
+real entry points (no device, no params materialized) and linting the host
+code for the repo-specific hazards:
+
+* :mod:`.jaxpr_checks` + :mod:`.entries` — GRAFT-J001..J006 over traced
+  jaxprs, AOT donation metadata, and the serve-sweep signature hash.
+* :mod:`.ast_checks` — GRAFT-A001..A004 source lint.
+* :mod:`.sharding_checks` — GRAFT-S001/S002 param-tree spec coverage.
+* :mod:`.cli` — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``;
+  nonzero exit on non-baselined findings; ``--fix-baseline`` regenerates
+  the reviewed allowlist.
+
+This module stays import-light (no jax) so the CLI can pin the platform
+before tracing.
+"""
+
+from ddim_cold_tpu.analysis.findings import RULES, Finding  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from ddim_cold_tpu.analysis.cli import main
+
+sys.exit(main())

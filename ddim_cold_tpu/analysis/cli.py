@@ -1,0 +1,108 @@
+"""graftcheck CLI — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``.
+
+Runs the three layers (AST lint, jaxpr entry checks + serve-signature
+sweep, sharding coverage), subtracts the reviewed ``--baseline`` allowlist,
+prints the rest and exits nonzero if any remain. ``--fix-baseline``
+regenerates the allowlist deterministically instead (sorted, deduped) so
+its diffs review cleanly.
+
+The jaxpr layer traces real model code, so the CLI pins jax to CPU before
+any trace (the check is backend-independent — it never executes a program)
+unless ``--platform`` says otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ddim_cold_tpu.analysis import findings as F
+
+LAYERS = ("ast", "jaxpr", "sharding")
+
+
+def repo_root() -> str:
+    """The directory holding the ``ddim_cold_tpu`` package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
+            ) -> list[F.Finding]:
+    """All findings from the requested layers, sorted for stable output."""
+    out: list[F.Finding] = []
+    if "ast" in only:
+        from ddim_cold_tpu.analysis import ast_checks
+
+        out += ast_checks.lint_tree(root)
+    if "jaxpr" in only:
+        from ddim_cold_tpu.analysis import entries
+
+        out += entries.run_entry_checks(max_const_bytes=max_const_bytes)
+        out += entries.run_serve_signature_check()
+    if "sharding" in only:
+        from ddim_cold_tpu.analysis import sharding_checks
+
+        out += sharding_checks.run_sharding_checks()
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="static analysis of the ddim_cold_tpu TPU invariants")
+    ap.add_argument("--root", default=repo_root(),
+                    help="repo root holding the ddim_cold_tpu package")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="reviewed allowlist; listed findings don't fail "
+                         "the run (missing file = empty baseline)")
+    ap.add_argument("--fix-baseline", default=None, metavar="FILE",
+                    help="write the current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--only", action="append", choices=LAYERS, default=None,
+                    help="run a subset of layers (repeatable)")
+    ap.add_argument("--max-const-bytes", type=int, default=1 << 20,
+                    help="GRAFT-J004 threshold (default 1 MiB)")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for abstract tracing (default cpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(F.RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    # the environment may pre-select an accelerator platform; tracing is
+    # abstract, so pin the cheap backend before the first jax import runs
+    # device discovery (post-import config update — same as tests/conftest)
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    only = tuple(args.only) if args.only else LAYERS
+    all_findings = collect(args.root, only=only,
+                           max_const_bytes=args.max_const_bytes)
+
+    if args.fix_baseline:
+        n = F.write_baseline(args.fix_baseline, all_findings)
+        print(f"graftcheck: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {args.fix_baseline}")
+        return 0
+
+    baseline = F.load_baseline(args.baseline)
+    fresh = [f for f in all_findings if f.key not in baseline]
+    suppressed = len(all_findings) - len(fresh)
+    for f in fresh:
+        print(f.render())
+    tail = f" ({suppressed} baselined)" if suppressed else ""
+    print(f"graftcheck: {len(fresh)} finding"
+          f"{'' if len(fresh) == 1 else 's'}{tail} "
+          f"[layers: {', '.join(only)}]")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

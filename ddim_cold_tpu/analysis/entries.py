@@ -1,0 +1,249 @@
+"""The traced-entry registry: which real hot paths graftcheck proves.
+
+Each :class:`Entry` names one jitted entry point and the abstract arguments
+(``ShapeDtypeStruct``) to trace it with — the SAME functions the samplers,
+the trainer and the serving engine dispatch, at the tiny model geometry
+``tests/test_serve.py`` uses (so the serve-sweep signature check covers
+exactly the warmed ``(SamplerConfig, bucket)`` pairs that suite proves
+empirically). Tracing is abstract end to end: params come from
+``jax.eval_shape(model.init, ...)``, quantized params from
+``eval_shape(quantize_params, ...)`` — no parameter is ever materialized.
+
+Geometry is small but structurally faithful — every check here is about
+graph *structure* (dtypes, aliasing, constants, callbacks, trace identity),
+which does not change with width/depth, only with code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ddim_cold_tpu.analysis import jaxpr_checks
+from ddim_cold_tpu.analysis.findings import Finding
+
+#: tests/test_serve.py's model geometry — keep in sync (test_analysis.py
+#: asserts equality so the serve sweep and the empirical guard can't drift)
+TINY = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
+            num_heads=4, total_steps=2000)
+K = 500    # the 4-reverse-step stride test_serve.py warms
+N = 4      # batch rows for the non-serve entries
+
+#: the warmed (SamplerConfig, buckets) sweep tests/test_serve.py +
+#: tests/test_quant.py cover — built lazily (SamplerConfig import)
+def serve_sweep():
+    from ddim_cold_tpu.serve.batching import SamplerConfig
+
+    return [
+        ("ddim_k500", SamplerConfig(k=K), (4, 8)),
+        ("ddim_k500_ci2", SamplerConfig(k=K, cache_interval=2), (4, 8)),
+        ("cold_l4", SamplerConfig(sampler="cold", levels=4), (4, 8)),
+        ("ddim_k500_t999", SamplerConfig(k=K, t_start=999), (4, 8)),
+        ("ddim_k500_qxla", SamplerConfig(k=K, quant="xla"), (4,)),
+    ]
+
+
+@dataclass
+class Entry:
+    """One traced entry point. ``jitted(*static_args, *dyn_args, **kwargs)``
+    is the exact dispatch; ``path`` is where findings point."""
+
+    name: str
+    path: str
+    jitted: Any
+    dyn_args: tuple
+    static_args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    donates: bool = False
+
+    def _call(self, *dyn):
+        return self.jitted(*self.static_args, *dyn, **self.kwargs)
+
+    def trace(self):
+        return jax.make_jaxpr(self._call)(*self.dyn_args)
+
+    def out_shapes(self):
+        return jax.eval_shape(self._call, *self.dyn_args)
+
+    def args_info(self):
+        return self.jitted.lower(*self.static_args, *self.dyn_args,
+                                 **self.kwargs).args_info
+
+
+class Context:
+    """One independently constructed (model, abstract params) world. The
+    signature check builds two and demands identical trace hashes — flax
+    modules hash by field values, so a fresh instance MUST retrace to the
+    same program or serving would recompile on every engine restart."""
+
+    def __init__(self):
+        from ddim_cold_tpu.models import DiffusionViT
+        from ddim_cold_tpu.ops import quant
+
+        self.model = DiffusionViT(**TINY)
+        H, W = self.model.img_size
+        self.key = jax.random.PRNGKey(0)
+        x2 = jax.ShapeDtypeStruct((2, H, W, self.model.in_chans), jnp.float32)
+        t2 = jax.ShapeDtypeStruct((2,), jnp.int32)
+        self.params = jax.eval_shape(self.model.init, self.key, x2,
+                                     t2)["params"]
+        self.qmodel = self.model.clone(quant="xla")
+        self.qparams = jax.eval_shape(quant.quantize_params, self.params)
+
+    def x(self, n: int):
+        H, W = self.model.img_size
+        return jax.ShapeDtypeStruct((n, H, W, self.model.in_chans),
+                                    jnp.float32)
+
+    def cache(self, n: int):
+        from ddim_cold_tpu.ops import step_cache
+
+        return jax.eval_shape(
+            lambda: step_cache.init_cache(n, self.model.num_patches + 1,
+                                          self.model.embed_dim,
+                                          self.model.dtype))
+
+
+def build_entries(ctx: Context) -> list[Entry]:
+    from ddim_cold_tpu.ops import quant, sampling
+    from ddim_cold_tpu.train.step import create_train_state, make_train_step
+
+    SAMP = "ddim_cold_tpu/ops/sampling.py"
+    m, p, key = ctx.model, ctx.params, ctx.key
+    x = ctx.x(N)
+    ddim_kw = dict(k=K, t_start=None, eta=0.0)
+    entries = [
+        Entry("ddim_scan_last", SAMP, sampling._ddim_scan_last,
+              (p, x, key), (m,), dict(ddim_kw), donates=True),
+        Entry("ddim_scan_guided", SAMP, sampling._ddim_scan_last,
+              (p, x, key), (m,), dict(ddim_kw, t_start=999), donates=True),
+        Entry("ddim_scan_sequence", SAMP, sampling._ddim_scan_sequence,
+              (p, x, key), (m,), dict(ddim_kw)),
+        Entry("ddim_scan_cached", SAMP, sampling._ddim_scan_cached,
+              (p, x, key, ctx.cache(N)), (m,),
+              dict(ddim_kw, cache_interval=2, cache_mode="delta",
+                   sequence=False), donates=True),
+        Entry("cold_scan", SAMP, sampling._cold_scan, (p, x), (m,),
+              dict(levels=4, return_sequence=False), donates=True),
+        Entry("cold_scan_cached", SAMP, sampling._cold_scan_cached,
+              (p, x, ctx.cache(N)), (m,),
+              dict(levels=4, return_sequence=False, cache_interval=2,
+                   cache_mode="delta"), donates=True),
+        Entry("ddim_scan_last_w8a16", "ddim_cold_tpu/ops/quant.py",
+              sampling._ddim_scan_last, (ctx.qparams, ctx.x(N), key),
+              (ctx.qmodel,), dict(ddim_kw), donates=True),
+        Entry("dequant_matmul_xla", "ddim_cold_tpu/ops/quant.py",
+              jax.jit(quant.dequant_matmul, static_argnames=("mode",)),
+              (jax.ShapeDtypeStruct((8, 32), jnp.bfloat16),
+               jax.ShapeDtypeStruct((32, 64), jnp.int8),
+               jax.ShapeDtypeStruct((64,), jnp.float32)),
+              (), dict(mode="xla")),
+    ]
+
+    TRAIN = "ddim_cold_tpu/train/step.py"
+    H, W = m.img_size
+    noisy = jax.ShapeDtypeStruct((N, H, W, m.in_chans), jnp.float32)
+    t = jax.ShapeDtypeStruct((N,), jnp.int32)
+    state = jax.eval_shape(
+        lambda k, nz, tt: create_train_state(m, k, 1e-3, 100, (nz, None, tt)),
+        key, noisy, t)
+    loss_rec = jax.ShapeDtypeStruct((), jnp.float32)
+    entries.append(Entry(
+        "train_step", TRAIN, make_train_step(m),
+        (state, (noisy, noisy, t), key, loss_rec), donates=True))
+    return entries
+
+
+def run_entry_checks(max_const_bytes: int = 1 << 20) -> list[Finding]:
+    """J001–J005 over every registered entry."""
+    ctx = Context()
+    findings: list[Finding] = []
+    for e in build_entries(ctx):
+        closed = e.trace()
+        out_shapes = e.out_shapes()
+        findings += jaxpr_checks.check_accumulation(closed, e.name, e.path)
+        findings += jaxpr_checks.check_weak_types(out_shapes, e.name, e.path)
+        findings += jaxpr_checks.check_donation(
+            e.args_info(), out_shapes, e.name, e.path,
+            expect_donation=e.donates)
+        findings += jaxpr_checks.check_constants(closed, e.name, e.path,
+                                                 max_bytes=max_const_bytes)
+        findings += jaxpr_checks.check_host_callbacks(closed, e.name, e.path)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# J006 — the serve-sweep signature check
+# ---------------------------------------------------------------------------
+
+def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
+    """The exact dispatch serve/engine.py's ``_build_program`` AOT-compiles
+    for (config, bucket) — same functions, same statics, same aval shapes —
+    mirrored here so its trace identity is checked statically."""
+    from ddim_cold_tpu.ops import sampling
+
+    model = ctx.qmodel if config.quant else ctx.model
+    params = ctx.qparams if config.quant else ctx.params
+    x = ctx.x(bucket)
+    if config.sampler == "cold":
+        if config.cached:
+            return Entry("serve", "", sampling._cold_scan_cached,
+                         (params, x, ctx.cache(bucket)), (model,),
+                         dict(levels=config.levels, return_sequence=False,
+                              cache_interval=config.cache_interval,
+                              cache_mode=config.cache_mode))
+        return Entry("serve", "", sampling._cold_scan, (params, x), (model,),
+                     dict(levels=config.levels, return_sequence=False))
+    if config.cached:
+        return Entry("serve", "", sampling._ddim_scan_cached,
+                     (params, x, ctx.key, ctx.cache(bucket)), (model,),
+                     dict(k=config.k, t_start=config.t_start, eta=0.0,
+                          cache_interval=config.cache_interval,
+                          cache_mode=config.cache_mode, sequence=False))
+    return Entry("serve", "", sampling._ddim_scan_last,
+                 (params, x, ctx.key), (model,),
+                 dict(k=config.k, t_start=config.t_start, eta=0.0))
+
+
+def serve_signatures(ctx: Context) -> dict[str, str]:
+    """``"<label>:b<bucket>" → trace hash`` for the whole warmed sweep."""
+    out = {}
+    for label, config, buckets in serve_sweep():
+        for bucket in buckets:
+            e = _serve_entry(ctx, config, bucket)
+            out[f"{label}:b{bucket}"] = jaxpr_checks.signature_hash(
+                e.trace(), e.dyn_args)
+    return out
+
+
+def run_serve_signature_check() -> list[Finding]:
+    """Trace the warmed sweep twice with independently built model/param
+    worlds. Hash instability across worlds = a retrace would MISS the AOT
+    executable (a serve-time compile); a hash shared by two distinct
+    (config, bucket) pairs = the programs are indistinguishable at the
+    abstract level, so the check itself lost resolution — both are J006."""
+    PATH = "ddim_cold_tpu/serve/engine.py"
+    sigs_a = serve_signatures(Context())
+    sigs_b = serve_signatures(Context())
+    findings = []
+    by_hash: dict[str, str] = {}
+    for subject, h in sigs_a.items():
+        if sigs_b[subject] != h:
+            findings.append(Finding(
+                "GRAFT-J006", PATH, f"unstable:{subject}", 0,
+                f"serve pair {subject} traces to a different program hash "
+                "from an independently built model — warmup's AOT "
+                "executable would not be reused (serve-time recompile)"))
+            continue
+        if h in by_hash:
+            findings.append(Finding(
+                "GRAFT-J006", PATH, f"collision:{subject}", 0,
+                f"serve pairs {by_hash[h]} and {subject} hash to the same "
+                "abstract program — distinct configs must compile distinct "
+                "programs or the signature check has lost resolution"))
+        else:
+            by_hash[h] = subject
+    return findings

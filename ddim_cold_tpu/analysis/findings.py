@@ -1,0 +1,108 @@
+"""Finding records, the rule table, and the reviewed-baseline grammar.
+
+A :class:`Finding` is one rule violation at one place. The ``(rule, path,
+subject)`` triple is the finding's identity: line numbers drift with every
+edit, so the baseline (the reviewed allowlist ``--baseline`` consumes and
+``--fix-baseline`` regenerates) keys on the stable triple and carries the
+line only for display. ``subject`` is chosen per rule to survive unrelated
+edits — an entry-point name, an enclosing-function + callee pair, a fault
+site, a '/'-joined param-leaf path.
+
+Baseline grammar (one finding per line, ``#`` comments and blanks ignored)::
+
+    <RULE-ID> <path> :: <subject>
+    GRAFT-A002 ddim_cold_tpu/data/datasets.py :: _probe_uniform_u8:Exception
+
+``--fix-baseline`` writes the file sorted and de-duplicated so regenerated
+baselines diff cleanly under review.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+#: rule id → one-line description. Stable ids: tests, baselines and CI grep
+#: these — never renumber, only append.
+RULES = {
+    "GRAFT-J001": "low-precision (bf16/f16) accumulation in a matmul/conv — "
+                  "violates the bf16-trunk/f32-accumulate dtype policy",
+    "GRAFT-J002": "weak-typed float output from a traced entry point — "
+                  "promotion hazard and a jit-cache-miss (recompile) hazard",
+    "GRAFT-J003": "donated buffer XLA would drop: no output matches the "
+                  "donated aval's (shape, dtype), so donation frees nothing",
+    "GRAFT-J004": "oversized constant baked into a traced program — HBM "
+                  "bloat and a compile-cache poison (const bytes are keyed)",
+    "GRAFT-J005": "host callback primitive inside a scanned sampler body — "
+                  "forces host sync every step of the scan",
+    "GRAFT-J006": "unstable or colliding abstract trace signature across the "
+                  "serve sweep — breaks the zero-compiles-after-warmup "
+                  "guarantee",
+    "GRAFT-A001": "wall-clock/stdlib-random call inside a jitted or scanned "
+                  "function — nondeterminism the fault-replay contract "
+                  "(utils/faults.py) forbids",
+    "GRAFT-A002": "broad `except Exception`/bare `except` without a "
+                  "`# noqa: BLE001` justification on the same line",
+    "GRAFT-A003": "faults.fire() site violation: unregistered site name, "
+                  "non-literal site, or duplicate (site, tag) pair",
+    "GRAFT-A004": "device-array (jnp/jax) call in a host-only serve module — "
+                  "would force a device sync inside row planning",
+    "GRAFT-S001": "trunk GEMM param leaf (qkv/proj/fc1/fc2 kernel|w_int8) "
+                  "fell through to a replicated spec on a model-axis mesh",
+    "GRAFT-S002": "param leaf without a usable PartitionSpec (structure "
+                  "mismatch, rank overflow, or unknown mesh axis)",
+}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation. Identity (baseline key) is (rule, path, subject);
+    ``line``/``message`` are display-only."""
+
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    subject: str       # stable short identifier within the file/check
+    line: int = field(default=0, compare=True)
+    message: str = field(default="", compare=False)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule} {self.path} :: {self.subject}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.rule} {loc} [{self.subject}] {self.message}"
+
+
+def load_baseline(path: str | None) -> set[str]:
+    """Parse a baseline file into the set of suppressed finding keys. A
+    missing file is an empty baseline (strict), never an error — CI can pass
+    the flag unconditionally."""
+    keys: set[str] = set()
+    if not path or not os.path.isfile(path):
+        return keys
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if " :: " not in line or not line.split(" ", 1)[0] in RULES:
+                raise ValueError(
+                    f"{path}: malformed baseline line {line!r} "
+                    "(expected '<RULE-ID> <path> :: <subject>')")
+            keys.add(line)
+    return keys
+
+
+def write_baseline(path: str, findings: list[Finding]) -> int:
+    """Regenerate the allowlist deterministically: header, then the sorted,
+    de-duplicated keys of ``findings`` — reviewed diffs stay minimal."""
+    keys = sorted({f.key for f in findings})
+    with open(path, "w") as f:
+        f.write("# graftcheck baseline — reviewed allowlist of known "
+                "findings.\n")
+        f.write("# One per line: <RULE-ID> <path> :: <subject>   "
+                "(regenerate: graftcheck --fix-baseline)\n")
+        for k in keys:
+            f.write(k + "\n")
+    return len(keys)

@@ -1,0 +1,228 @@
+"""Jaxpr-level checks (GRAFT-J001..J005) over abstractly traced entry points.
+
+Everything here works on ``jax.make_jaxpr`` output plus the AOT metadata of
+the jitted entry (``.lower(...).args_info`` for donation flags,
+``jax.eval_shape`` for output avals) — no device arrays are ever allocated,
+so the whole pass runs on any backend in milliseconds.
+
+Jaxprs nest: a jitted call is one ``pjit`` eqn whose body lives in
+``eqn.params["jaxpr"]``; ``lax.scan`` bodies, ``cond``/``switch`` branches,
+``while`` cond/body and ``pallas_call`` kernels likewise hang off eqn
+params. :func:`iter_eqns` walks the whole tree and tracks whether the
+current eqn sits inside a scan/while body — the "per step of the sampler
+loop" context rules J005 cares about.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import Counter
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+from ddim_cold_tpu.analysis.findings import Finding
+
+#: dtypes on the wrong side of the f32-accumulate policy
+_LOW_PRECISION = ("bfloat16", "float16")
+
+#: eqn params that hold nested jaxprs. Values are either a (Closed)Jaxpr,
+#: a list/tuple of them (cond/switch 'branches'), or something else entirely
+#: (ignored).
+_SUB_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "branches",
+                   "cond_jaxpr", "body_jaxpr")
+
+#: primitives that re-enter the host every execution of their body/site
+_HOST_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+                        "outside_call", "host_callback_call", "callback")
+
+#: primitives whose body executes once per carried step
+_LOOP_PRIMS = ("scan", "while")
+
+
+def _as_jaxprs(val) -> list:
+    """Normalize an eqn-param value to a list of open Jaxprs."""
+    vals = val if isinstance(val, (list, tuple)) else [val]
+    out = []
+    for v in vals:
+        v = getattr(v, "jaxpr", v)  # ClosedJaxpr → Jaxpr
+        if hasattr(v, "eqns"):
+            out.append(v)
+    return out
+
+
+def iter_eqns(jaxpr, in_loop: bool = False) -> Iterator[tuple[Any, bool]]:
+    """Yield ``(eqn, inside_loop_body)`` over ``jaxpr`` and every sub-jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        enters_loop = in_loop or eqn.primitive.name in _LOOP_PRIMS
+        for key in _SUB_JAXPR_KEYS:
+            if key in eqn.params:
+                for sub in _as_jaxprs(eqn.params[key]):
+                    yield from iter_eqns(sub, enters_loop)
+
+
+def iter_consts(closed_jaxpr) -> Iterator[Any]:
+    """Yield every constant captured by ``closed_jaxpr`` or a nested one."""
+    yield from getattr(closed_jaxpr, "consts", ())
+    for eqn, _ in iter_eqns(closed_jaxpr):
+        for key in _SUB_JAXPR_KEYS:
+            val = eqn.params.get(key)
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for v in vals:
+                yield from getattr(v, "consts", ())
+
+
+def _dtype_name(aval) -> str:
+    return str(np.dtype(aval.dtype)) if hasattr(aval, "dtype") else "?"
+
+
+# ---------------------------------------------------------------------------
+# J001 — low-precision accumulation
+# ---------------------------------------------------------------------------
+
+def check_accumulation(closed_jaxpr, entry: str, path: str) -> list[Finding]:
+    """Flag matmul/conv eqns that BOTH consume and produce low precision —
+    i.e. traced without ``preferred_element_type=f32``, so the MXU
+    accumulates in bf16. A low-precision *input* with an f32 *output* is the
+    designed bf16-trunk/f32-accumulate pattern (ops/quant.py, flash kernel)
+    and passes; so does a post-accumulation ``convert_element_type`` emit
+    cast."""
+    out, idx = [], Counter()
+    for eqn, _ in iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim not in ("dot_general", "conv_general_dilated"):
+            continue
+        in_dts = [_dtype_name(v.aval) for v in eqn.invars[:2]]
+        out_dt = _dtype_name(eqn.outvars[0].aval)
+        idx[prim] += 1
+        if any(d in _LOW_PRECISION for d in in_dts) and out_dt in _LOW_PRECISION:
+            out.append(Finding(
+                "GRAFT-J001", path, f"{entry}:{prim}#{idx[prim]}", 0,
+                f"{prim} #{idx[prim]} in `{entry}` accumulates in {out_dt} "
+                f"(inputs {'/'.join(in_dts)}) — trace it with "
+                "preferred_element_type=float32 and cast at emit"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# J002 — weak-typed outputs
+# ---------------------------------------------------------------------------
+
+def check_weak_types(out_shapes, entry: str, path: str) -> list[Finding]:
+    """Weak-typed float outputs promote silently downstream and, fed back
+    into a jitted callee, miss the cache a strong-typed aval populated —
+    the recompile hazard."""
+    out = []
+    leaves = jax.tree_util.tree_leaves(out_shapes)
+    for i, leaf in enumerate(leaves):
+        if getattr(leaf, "weak_type", False):
+            out.append(Finding(
+                "GRAFT-J002", path, f"{entry}:out{i}", 0,
+                f"output {i} of `{entry}` is weak-typed "
+                f"{_dtype_name(leaf)}{tuple(leaf.shape)} — anchor it with an "
+                "explicit jnp.asarray(..., dtype) before returning"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# J003 — droppable donations
+# ---------------------------------------------------------------------------
+
+def check_donation(args_info, out_shapes, entry: str, path: str,
+                   expect_donation: bool = True) -> list[Finding]:
+    """XLA aliases a donated input only to an output with the identical
+    (shape, dtype); anything else is silently dropped (the buffer is freed
+    late and the donation buys nothing). Match the donated avals against the
+    output avals as multisets — each output slot can absorb one donation."""
+    donated = []
+    for key_path, info in jax.tree_util.tree_flatten_with_path(args_info)[0]:
+        if getattr(info, "donated", False):
+            label = jax.tree_util.keystr(key_path)
+            donated.append((label, tuple(info.shape), _dtype_name(info)))
+    if expect_donation and not donated:
+        return [Finding(
+            "GRAFT-J003", path, f"{entry}:<none-donated>", 0,
+            f"`{entry}` is expected to donate its carry buffers but lowered "
+            "with zero donated inputs")]
+    budget = Counter(
+        (tuple(leaf.shape), _dtype_name(leaf))
+        for leaf in jax.tree_util.tree_leaves(out_shapes))
+    out = []
+    for label, shape, dtype in donated:
+        if budget[(shape, dtype)] > 0:
+            budget[(shape, dtype)] -= 1
+        else:
+            out.append(Finding(
+                "GRAFT-J003", path, f"{entry}:{label}", 0,
+                f"donated arg {label} of `{entry}` ({dtype}{shape}) matches "
+                "no remaining output aval — XLA drops the donation "
+                "(jax warns at runtime; the buffer is never reused)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# J004 — oversized baked-in constants
+# ---------------------------------------------------------------------------
+
+def check_constants(closed_jaxpr, entry: str, path: str,
+                    max_bytes: int = 1 << 20) -> list[Finding]:
+    """Closure-captured arrays are baked into the compiled program: they
+    occupy HBM per-executable and key the compile cache by VALUE, so a big
+    one both bloats memory and poisons cache reuse. Coefficient tables are
+    tiny; anything over ``max_bytes`` should be an argument instead."""
+    out = []
+    for i, const in enumerate(iter_consts(closed_jaxpr)):
+        nbytes = getattr(const, "nbytes", None)
+        if nbytes is None:
+            size = int(np.prod(getattr(const, "shape", ()) or (1,)))
+            itemsize = np.dtype(getattr(const, "dtype", np.float32)).itemsize
+            nbytes = size * itemsize
+        if nbytes > max_bytes:
+            shape = tuple(getattr(const, "shape", ()))
+            out.append(Finding(
+                "GRAFT-J004", path, f"{entry}:const#{i}", 0,
+                f"`{entry}` bakes in a {nbytes}-byte constant "
+                f"(shape {shape}, threshold {max_bytes}) — pass it as an "
+                "argument so the executable and the compile cache stay lean"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# J005 — host callbacks in loop bodies
+# ---------------------------------------------------------------------------
+
+def check_host_callbacks(closed_jaxpr, entry: str, path: str) -> list[Finding]:
+    """A callback primitive inside a scan/while body syncs the device to the
+    host EVERY step — the exact serialization the scan samplers exist to
+    avoid."""
+    out, seen = [], set()
+    for eqn, in_loop in iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim in _HOST_CALLBACK_PRIMS and in_loop and prim not in seen:
+            seen.add(prim)
+            out.append(Finding(
+                "GRAFT-J005", path, f"{entry}:{prim}", 0,
+                f"host callback `{prim}` inside the scanned body of "
+                f"`{entry}` — every loop step round-trips to the host"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# abstract trace signature (J006 building block — used by entries.py)
+# ---------------------------------------------------------------------------
+
+def signature_hash(closed_jaxpr, in_tree) -> str:
+    """Hash of everything jit keys a compiled program on that we can see
+    statically: the printed jaxpr (structure + primitive params) and the
+    input avals. Two traces with equal hashes hit one executable; a hash
+    that moves between two traces of the same entry predicts a serve-time
+    recompile."""
+    avals = ",".join(
+        f"{_dtype_name(l)}{tuple(l.shape)}"
+        for l in jax.tree_util.tree_leaves(in_tree)
+        if hasattr(l, "shape"))
+    blob = f"{closed_jaxpr}\n#avals={avals}".encode()
+    return hashlib.sha256(blob).hexdigest()

@@ -1,0 +1,133 @@
+"""Sharding-coverage check (GRAFT-S001/S002): every param leaf must carry a
+usable PartitionSpec from ``parallel/sharding.py``.
+
+``param_partition_specs`` derives specs by module-path pattern matching, so
+a renamed module or a new leaf kind (exactly what ``quantize_params`` did
+when it introduced ``w_int8``/``scale``) silently falls through to the
+replicated default — correct-but-slow for small leaves, a scale-out
+regression when the fallen leaf is a trunk GEMM weight. This check walks
+the REAL param trees (float, quantized, stacked-scan, MoE — all abstract
+via ``eval_shape``) and flags:
+
+* S002 — structurally unusable specs: tree-structure mismatch between
+  params and specs, a spec longer than the leaf's rank, or a spec naming a
+  mesh axis outside the declared set.
+* S001 — a trunk GEMM leaf (``attn/{qkv,proj}``, ``mlp/{fc1,fc2}`` —
+  ``kernel`` or its ``w_int8`` encoding) whose spec does not mention the
+  'model' axis even though the axis set offers it: the Megatron split
+  silently degraded to replication.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddim_cold_tpu.analysis.findings import Finding
+
+PATH = "ddim_cold_tpu/parallel/sharding.py"
+
+#: the tiny geometry (analysis/entries.py TINY) with the layout variants
+#: whose param trees must all be covered
+TREE_VARIANTS = ("float", "quant", "scan_blocks", "moe")
+
+
+def _leaf_paths(tree, is_leaf=None):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    return {
+        "/".join(getattr(k, "key", str(k)) for k in path): leaf
+        for path, leaf in flat
+    }
+
+
+def _is_trunk_gemm(names: list[str]) -> bool:
+    from ddim_cold_tpu.ops import quant
+
+    return (names[-1] in ("kernel", "w_int8") and len(names) >= 2
+            and quant._is_trunk_dense(tuple(names[:-1])))
+
+
+def check_param_tree(params, specs, tag: str,
+                     axes=("model", "expert")) -> list[Finding]:
+    """Validate ``specs`` (a PartitionSpec tree) against ``params``."""
+    findings = []
+    p_leaves = _leaf_paths(params)
+    # P() must stay a leaf even on jax builds where PartitionSpec iterates
+    # like a tuple — an empty spec flattening to nothing would vanish
+    s_leaves = _leaf_paths(specs, is_leaf=lambda x: isinstance(
+        x, jax.sharding.PartitionSpec))
+    for missing in sorted(set(p_leaves) - set(s_leaves)):
+        findings.append(Finding(
+            "GRAFT-S002", PATH, f"{tag}:{missing}", 0,
+            f"param leaf {missing} ({tag} tree) has no PartitionSpec — "
+            "spec tree structure diverged from the param tree"))
+    for extra in sorted(set(s_leaves) - set(p_leaves)):
+        findings.append(Finding(
+            "GRAFT-S002", PATH, f"{tag}:{extra}", 0,
+            f"spec leaf {extra} ({tag} tree) matches no param leaf"))
+    for path in sorted(set(p_leaves) & set(s_leaves)):
+        leaf, spec = p_leaves[path], s_leaves[path]
+        names = path.split("/")
+        if not isinstance(spec, jax.sharding.PartitionSpec):
+            findings.append(Finding(
+                "GRAFT-S002", PATH, f"{tag}:{path}", 0,
+                f"spec for {path} ({tag} tree) is {type(spec).__name__}, "
+                "not a PartitionSpec"))
+            continue
+        ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        if len(spec) > ndim:
+            findings.append(Finding(
+                "GRAFT-S002", PATH, f"{tag}:{path}", 0,
+                f"spec {spec} for {path} ({tag} tree) has {len(spec)} "
+                f"entries but the leaf is rank {ndim} — sharding would "
+                "raise at placement"))
+            continue
+        flat_axes = [a for entry in spec if entry is not None
+                     for a in (entry if isinstance(entry, tuple)
+                               else (entry,))]
+        unknown = [a for a in flat_axes if a not in axes]
+        if unknown:
+            findings.append(Finding(
+                "GRAFT-S002", PATH, f"{tag}:{path}", 0,
+                f"spec {spec} for {path} ({tag} tree) names mesh axes "
+                f"{unknown} outside the declared set {tuple(axes)}"))
+            continue
+        if ("model" in axes and _is_trunk_gemm(names)
+                and "model" not in flat_axes):
+            findings.append(Finding(
+                "GRAFT-S001", PATH, f"{tag}:{path}", 0,
+                f"trunk GEMM leaf {path} ({tag} tree) fell through to "
+                f"replicated spec {spec} on a model-axis mesh — the "
+                "Megatron column/row split silently degraded"))
+    return findings
+
+
+def _tiny_params(**overrides):
+    from ddim_cold_tpu.analysis.entries import TINY
+    from ddim_cold_tpu.models import DiffusionViT
+
+    model = DiffusionViT(**{**TINY, **overrides})
+    H, W = model.img_size
+    x = jax.ShapeDtypeStruct((2, H, W, model.in_chans), jnp.float32)
+    t = jax.ShapeDtypeStruct((2,), jnp.int32)
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0), x, t)["params"]
+
+
+def run_sharding_checks() -> list[Finding]:
+    """S001/S002 over every layout variant's abstract param tree."""
+    from ddim_cold_tpu.ops import quant
+    from ddim_cold_tpu.parallel.sharding import param_partition_specs
+
+    findings = []
+    float_params = _tiny_params()
+    trees = {
+        "float": float_params,
+        "quant": jax.eval_shape(quant.quantize_params, float_params),
+        "scan_blocks": _tiny_params(scan_blocks=True),
+        "moe": _tiny_params(num_experts=2),
+    }
+    assert set(trees) == set(TREE_VARIANTS)
+    for tag, params in trees.items():
+        specs = param_partition_specs(params)
+        findings += check_param_tree(params, specs, tag)
+    return findings

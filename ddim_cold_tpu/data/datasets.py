@@ -89,7 +89,7 @@ class _BaseCache:
             try:
                 with Image.open(os.path.join(self.root, name)) as im:
                     return im.size == want
-            except Exception:
+            except Exception:  # noqa: BLE001 — PIL decode errors are legion; any failure just means "probe says no"
                 return False
 
         if not ok(self.imgList[0]):
@@ -130,7 +130,7 @@ class _BaseCache:
                 global _cache_reserved
                 with _cache_lock:
                     _cache_reserved -= res
-            except Exception:  # interpreter teardown: globals may be gone
+            except Exception:  # noqa: BLE001 — interpreter teardown: globals may be gone
                 pass
 
     @staticmethod
@@ -260,7 +260,7 @@ def pil_loader(path: str) -> Image.Image:
         try:
             img = Image.open(f)
             return img.convert("RGB")
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — re-raised below with the path attached
             # prepend the path in-place: constructing type(e) from a bare
             # string is not a safe contract across exception classes
             e.args = (f"{path}: " + (str(e.args[0]) if e.args else repr(e)),

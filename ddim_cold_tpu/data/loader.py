@@ -178,7 +178,7 @@ def _background_map(items, fn, depth: int):
                 if stop.is_set() or not put(fn(it)):
                     return
             put(None)
-        except BaseException as e:  # surface work errors to the consumer
+        except BaseException as e:  # noqa: BLE001 — worker thread: ANY error (incl. KeyboardInterrupt) must surface to the consumer
             put(e)
 
     thread = threading.Thread(target=producer, daemon=True)

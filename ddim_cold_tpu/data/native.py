@@ -50,7 +50,7 @@ def _build() -> bool:
         )
         os.replace(tmp, _SO_PATH)
         return True
-    except Exception:
+    except (subprocess.SubprocessError, OSError):  # compile failed / no g++
         try:
             os.unlink(tmp)
         except OSError:

@@ -55,10 +55,14 @@ def create_train_state(model, rng: jax.Array, lr: float, total_steps: int,
     seeds an EMA shadow of the params (see :class:`EmaTrainState`)."""
     noisy, _, t = sample_batch
     params = model.init(rng, jnp.asarray(noisy), jnp.asarray(t))["params"]
-    return EmaTrainState.create(
+    state = EmaTrainState.create(
         apply_fn=model.apply, params=params, tx=make_optimizer(lr, total_steps),
         ema_params=jax.tree.map(jnp.copy, params) if ema_decay else None,
     )
+    # flax seeds step=0 as a python int → weak-typed int32 through the jitted
+    # step, while a checkpoint-restored step is strong-typed — two avals, two
+    # compiles across a resume. Anchor it once here (GRAFT-J002).
+    return state.replace(step=jnp.asarray(0, jnp.int32))
 
 
 def make_train_step(model, apply_fn: Optional[Callable] = None,

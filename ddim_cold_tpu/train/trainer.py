@@ -133,7 +133,7 @@ class _AsyncSaver:
         def run():
             try:
                 fn()
-            except BaseException as e:  # re-raised on the main thread at wait()
+            except BaseException as e:  # noqa: BLE001 — re-raised on the main thread at wait()
                 self._error = e
 
         self._thread = threading.Thread(target=run, daemon=True)
@@ -410,7 +410,7 @@ def run(config: ExperimentConfig, base_dir: str, *, max_steps: Optional[int] = N
                    else dict(base_tpl, ema_params=state.params))
             try:
                 restored = ckpt.restore_checkpoint(config.resume, alt)
-            except Exception:
+            except Exception:  # noqa: BLE001 — retry failed for any reason: surface the ORIGINAL error
                 raise first_err
             if want_ema:
                 print_log("resume checkpoint has no ema_params — re-seeding "

@@ -44,7 +44,7 @@ class ScalarWriter:
             from torch.utils.tensorboard import SummaryWriter
 
             self._tb = SummaryWriter(log_dir=log_dir)
-        except Exception:
+        except Exception:  # noqa: BLE001 — optional dep: import OR construction may fail many ways; jsonl logging carries on
             self._tb = None
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:

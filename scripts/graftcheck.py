@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo-checkout entry for graftcheck (no install needed).
+
+Same CLI as ``python -m ddim_cold_tpu.analysis``::
+
+    python scripts/graftcheck.py --baseline graftcheck.baseline
+    python scripts/graftcheck.py --fix-baseline graftcheck.baseline
+    python scripts/graftcheck.py --list-rules
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddim_cold_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""Packaging metadata vs the on-disk tree (the PR-2 lesson: serve/ shipped
+in the repo but not in the wheel — imports worked from a checkout and broke
+on install). Python 3.10 has no tomllib, so the packages list is parsed
+with a regex pinned to pyproject's literal layout."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "ddim_cold_tpu"
+
+
+def _pyproject() -> str:
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        return f.read()
+
+
+def _declared_packages(text: str) -> set:
+    block = re.search(r"packages\s*=\s*\[(.*?)\]", text, re.S)
+    assert block, "pyproject.toml lost its [tool.setuptools] packages list"
+    return set(re.findall(r'"([^"]+)"', block.group(1)))
+
+
+def _on_disk_packages() -> set:
+    pkgs = set()
+    base = os.path.join(REPO, PKG)
+    for dirpath, dirnames, files in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        if "__init__.py" in files:
+            rel = os.path.relpath(dirpath, REPO)
+            pkgs.add(rel.replace(os.sep, "."))
+    return pkgs
+
+
+def test_packages_list_matches_tree():
+    declared = _declared_packages(_pyproject())
+    on_disk = _on_disk_packages()
+    missing = on_disk - declared   # in the repo, absent from the wheel
+    stale = declared - on_disk     # in the wheel list, gone from the repo
+    assert not missing, f"packages missing from pyproject.toml: {sorted(missing)}"
+    assert not stale, f"pyproject.toml lists nonexistent packages: {sorted(stale)}"
+
+
+def test_graftcheck_console_script():
+    text = _pyproject()
+    assert re.search(
+        r'graftcheck\s*=\s*"ddim_cold_tpu\.analysis\.cli:main"', text), \
+        "graftcheck console script missing from [project.scripts]"
+
+
+def test_console_script_target_importable():
+    from ddim_cold_tpu.analysis.cli import main
+
+    assert callable(main)
