@@ -179,6 +179,17 @@ def main(argv=None):
                          "counters (retries/quarantined/failed) and "
                          "compiles-after-warmup (recovery never compiles); "
                          "composes with --smoke for a CPU-budget run")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-router leg (serve/router.py): the "
+                         "same request stream twice through a 2-replica "
+                         "Router — once clean, once under a seeded chaos "
+                         "schedule that permanently kills one replica's "
+                         "dispatch and injects transients elsewhere — "
+                         "recording clean vs chaos img/s, hedge/failover "
+                         "counts, the replica replacement, and "
+                         "compiles-after-warmup (MUST be 0, replacement "
+                         "included); composes with --smoke for a CPU-budget "
+                         "run")
     ap.add_argument("--quant", action="store_true",
                     help="run the w8a16 quantized-inference legs "
                          "(ops/quant.py): 64px sampler in both dequant-matmul "
@@ -776,6 +787,105 @@ def main(argv=None):
 
         if args.faults:
             section("faults", run_faults)
+
+        def run_fleet():
+            # the fleet leg: one Router over TWO in-process replicas serves
+            # the same mixed stream twice — clean, then under a seeded
+            # chaos schedule that kills replica r0's dispatch outright
+            # (permanent) and sprays transients at assembly and placement.
+            # The contract being measured: survivors keep completing
+            # (degraded throughput, not an outage), the dead replica is
+            # drained AND replaced, and compiles-after-warmup stays 0
+            # across every replica — the replacement warms from the same
+            # (config, bucket) set, so it never compiles in service.
+            from ddim_cold_tpu import serve
+            from ddim_cold_tpu.utils import faults as fj
+
+            buckets = (2, 4) if args.smoke else (8, 32)
+            k_serve = 400 if args.smoke else 20
+            bmax = max(buckets)
+            cfg = serve.SamplerConfig(k=k_serve)
+            sizes = [bmax + 1, 1, bmax // 2, bmax, bmax // 2 - 1, bmax - 1]
+            mark(f"fleet spawn+warm 2 replicas buckets={buckets}",
+                 budget_s=3 * stall_s)
+            router = serve.Router(
+                serve.local_factory(model, state.params, buckets=buckets),
+                replicas=2, configs=[cfg], max_hedges=2)
+
+            def drain_stream(seed0):
+                t0 = time.perf_counter()
+                tickets = [router.submit(seed=seed0 + i, n=n_req, config=cfg)
+                           for i, n_req in enumerate(sizes)]
+                errs = [t.exception(timeout=600) for t in tickets]
+                wall = time.perf_counter() - t0
+                rows = sum(n for n, e in zip(sizes, errs) if e is None)
+                return errs, rows, wall
+
+            assert not fj.active()
+            mark("fleet clean drain")
+            _, rows_c, wall_c = drain_stream(500)
+            clean_ips = rows_c / wall_c if wall_c else 0.0
+            schedule = (
+                fj.FaultSpec("serve.dispatch", "permanent",
+                             match="replica:r0|"),
+                fj.FaultSpec("serve.assemble", "transient", rate=0.25,
+                             seed=11),
+                # scoped to r1: an unmatched place-transient can steer every
+                # request AWAY from r0 and the kill never fires — the r0
+                # placements must stay clean so the dispatch fault is hit
+                fj.FaultSpec("router.place", "transient", rate=0.2, seed=12,
+                             match="replica:r1|"),
+            )
+            mark("fleet chaos drain")
+            with fj.inject(*schedule) as plan:
+                errs, rows_x, wall_x = drain_stream(600)
+                injected, by_site = len(plan.realized), plan.by_site()
+                # let supervision finish the lifecycle: r0 retired, the
+                # fleet healed back to 2 replicas (replacement warmed
+                # inside the chaos scope — realism, not convenience)
+                deadline = time.perf_counter() + 30
+                while time.perf_counter() < deadline:
+                    h = router.health()
+                    if (h["retired_replicas"] >= 1
+                            and h["active_replicas"] == 2):
+                        break
+                    time.sleep(0.05)
+            chaos_ips = rows_x / wall_x if wall_x else 0.0
+            health = router.drain(timeout=60)
+            sub["fleet"] = {
+                "replicas": 2,
+                "clean_img_per_sec": round(clean_ips, 2),
+                "chaos_img_per_sec": round(chaos_ips, 2),
+                "degraded_ratio": round(chaos_ips / clean_ips, 3)
+                if clean_ips else None,
+                "injected": injected, "by_site": by_site,
+                "survivors": sum(1 for e in errs if e is None),
+                "failed_tickets": health["failed"],
+                "hedges": health["hedges"],
+                "failovers": health["failovers"],
+                "replicas_retired": health["retired_replicas"],
+                "replicas_spawned": health["replicas_spawned"],
+                "compiles_after_warmup": health["compiles_after_warmup"],
+                "stream_sizes": sizes, "buckets": list(buckets),
+                "k": k_serve,
+            }
+            log(f"fleet: clean {clean_ips:.2f} img/s, chaos "
+                f"{chaos_ips:.2f} img/s (ratio "
+                f"{sub['fleet']['degraded_ratio']}) under {injected} "
+                f"injections {by_site}; hedges {health['hedges']}, "
+                f"failovers {health['failovers']}, retired "
+                f"{health['retired_replicas']}, spawned "
+                f"{health['replicas_spawned']}; compiles after warmup: "
+                f"{health['compiles_after_warmup']}")
+            if health["compiles_after_warmup"] != 0:
+                raise RuntimeError(
+                    "fleet zero-compile contract broken: "
+                    f"{health['compiles_after_warmup']} compiles after "
+                    "warmup (replacement must warm from the same "
+                    "(config, bucket) set)")
+
+        if args.fleet:
+            section("fleet", run_fleet)
 
         def run_quant64():
             # w8a16 sampler legs at 64px (ops/quant.py), both dequant-matmul

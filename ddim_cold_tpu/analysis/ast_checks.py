@@ -51,9 +51,13 @@ _NONDET_MODULES = {
 #: modules that imply device interaction in host-only files (rule A004)
 _DEVICE_MODULES = ("jax.numpy", "jax")
 
-#: serve modules whose row planning must never touch a device array —
-#: repo-relative paths with '/' separators
-HOST_ONLY_MODULES = ("ddim_cold_tpu/serve/batching.py",)
+#: serve modules that must never touch a device array (repo-relative paths
+#: with '/' separators): row planning (batching) and fleet routing —
+#: placement decisions reading health dicts must stay host-typed, or every
+#: routing tick forces a device sync
+HOST_ONLY_MODULES = ("ddim_cold_tpu/serve/batching.py",
+                     "ddim_cold_tpu/serve/fleet.py",
+                     "ddim_cold_tpu/serve/router.py")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:

@@ -224,7 +224,13 @@ def run_serve_signature_check() -> list[Finding]:
     worlds. Hash instability across worlds = a retrace would MISS the AOT
     executable (a serve-time compile); a hash shared by two distinct
     (config, bucket) pairs = the programs are indistinguishable at the
-    abstract level, so the check itself lost resolution — both are J006."""
+    abstract level, so the check itself lost resolution — both are J006.
+
+    This cross-world stability is also the fleet replacement proof
+    (serve/router.py): a replacement replica warms from the same
+    (config, bucket) set in a freshly built world, which is exactly the
+    world-B trace here — hash-equal programs mean the replacement serves
+    from its own warmup without a single in-service compile."""
     PATH = "ddim_cold_tpu/serve/engine.py"
     sigs_a = serve_signatures(Context())
     sigs_b = serve_signatures(Context())

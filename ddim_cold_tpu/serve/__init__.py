@@ -7,6 +7,10 @@ fast program per batch shape, and this package turns it into a service loop:
 * ``engine``    — AOT-compiled dispatch with H2D/D2H–compute overlap
 * ``warmup``    — compile every (config, bucket) program up front + wire the
                   persistent compilation cache so restarts skip XLA entirely
+* ``fleet``     — replica handles (lifecycle unit; in-process backend now,
+                  subprocess/host later behind the same interface)
+* ``router``    — health-aware placement over N replicas with hedged
+                  re-placement, replica replacement, and tenant QoS
 
 Quickstart::
 
@@ -29,12 +33,14 @@ from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
                                         EngineClosedError, EngineStalledError,
                                         QueueFullError, RequestFailedError,
                                         RequestQuarantinedError, ServeError)
+from ddim_cold_tpu.serve.fleet import LocalReplica, ReplicaHandle, local_factory
+from ddim_cold_tpu.serve.router import Router
 from ddim_cold_tpu.serve.warmup import warmup
 
 __all__ = [
     "BatchPlan", "DeadlineExceeded", "Engine", "EngineClosedError",
-    "EngineStalledError", "QueueFullError", "Request", "RequestFailedError",
-    "RequestQuarantinedError", "RETRYABLE_EXCEPTIONS", "SamplerConfig",
-    "ServeError", "Ticket", "cover_rows", "plan_batches", "select_bucket",
-    "warmup",
+    "EngineStalledError", "LocalReplica", "QueueFullError", "ReplicaHandle",
+    "Request", "RequestFailedError", "RequestQuarantinedError",
+    "RETRYABLE_EXCEPTIONS", "Router", "SamplerConfig", "ServeError", "Ticket",
+    "cover_rows", "local_factory", "plan_batches", "select_bucket", "warmup",
 ]

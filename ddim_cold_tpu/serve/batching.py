@@ -93,6 +93,35 @@ class Ticket:
         self._remaining = int(n)
         self._error: Optional[BaseException] = None
         self._health_cb = None  # engine attaches its health snapshot hook
+        self._callbacks: list = []
+
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn(ticket)`` once, when the ticket resolves (completed OR
+        failed). Fires immediately if already resolved. Callbacks run on the
+        resolving thread, outside the ticket lock; exceptions are swallowed
+        (a broken observer must not poison engine delivery). The fleet
+        router rides this to learn a placement's outcome without a thread
+        per ticket."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn) -> None:
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001 — observers must not poison delivery
+            pass
+
+    def _resolve(self) -> None:
+        """Set the event and fire registered callbacks (resolver thread)."""
+        self.done_time = time.perf_counter()
+        self._event.set()
+        with self._lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
 
     def _deliver(self, lo: int, hi: int, rows: np.ndarray) -> bool:
         """Engine-side: land request rows [lo, hi). True when complete.
@@ -107,8 +136,7 @@ class Ticket:
             self._remaining -= hi - lo
             done = self._remaining == 0
         if done:
-            self.done_time = time.perf_counter()
-            self._event.set()
+            self._resolve()
         return done
 
     def _fail(self, exc: BaseException) -> bool:
@@ -119,8 +147,7 @@ class Ticket:
             if self._event.is_set() or self._error is not None:
                 return False
             self._error = exc
-        self.done_time = time.perf_counter()
-        self._event.set()
+        self._resolve()
         return True
 
     @property

@@ -111,9 +111,16 @@ class Engine:
                  max_queue: Optional[int] = None,
                  max_retries: int = 2, retry_base_s: float = 0.05,
                  retry_cap_s: float = 1.0,
-                 stall_s: Optional[float] = None):
+                 stall_s: Optional[float] = None,
+                 replica_id: str = ""):
         self.model = model
         self.mesh = mesh
+        # fleet identity: names this engine in fault tags ("replica:r0|" —
+        # chaos specs can target one replica), failure messages, and the
+        # health snapshot, so fleet-level failures are attributable
+        self.replica_id = str(replica_id)
+        self._rname = (f"replica {self.replica_id!r}" if self.replica_id
+                       else "engine")
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive, got {buckets!r}")
@@ -158,6 +165,11 @@ class Engine:
         self._wd: Optional[StallWatchdog] = None
         self._idle = threading.Event()
         self._idle.set()
+        self._t0 = time.monotonic()
+        # (monotonic time, label) of the last pipeline beacon — health()
+        # surfaces its age as last_progress_s so a router can spot a wedged
+        # replica from the snapshot alone, before the watchdog fires
+        self._last_mark = (self._t0, "init")
         self.quarantined: list[int] = []  # rids bisection isolated
         self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
                       "padded_rows": 0, "max_queue_depth": 0,
@@ -337,10 +349,13 @@ class Engine:
         return req._x_full
 
     def _tag(self, plan: BatchPlan) -> str:
-        """Fault/beacon tag: ``|``-separated fields naming the bucket and
-        every request in the batch (``match="req:3|"`` targets request 3)."""
+        """Fault/beacon tag: ``|``-separated fields naming the replica (when
+        fleet-owned), the bucket, and every request in the batch
+        (``match="req:3|"`` targets request 3; ``match="replica:r0|"``
+        targets every batch of one replica)."""
         reqs = {id(req): req for req, *_ in plan.entries}
-        return (f"bucket:{plan.bucket}|"
+        head = f"replica:{self.replica_id}|" if self.replica_id else ""
+        return (head + f"bucket:{plan.bucket}|"
                 + "".join(f"req:{r.rid}|" for r in reqs.values()))
 
     def _assemble(self, plan: BatchPlan):
@@ -453,8 +468,9 @@ class Engine:
                 self.stats["deadline_expired"] += 1
                 self._fail_request(req, DeadlineExceeded(
                     f"request {req.rid} missed its deadline before dispatch "
-                    f"(expired {now - req.deadline:.3f}s ago waiting for a "
-                    "bucket) — failing fast instead of occupying one"))
+                    f"on {self._rname} (expired {now - req.deadline:.3f}s "
+                    "ago waiting for a bucket) — failing fast instead of "
+                    "occupying one"))
         if all(req.ticket.failed for req, *_ in plan.entries):
             self.stats["skipped_batches"] += 1
             return []
@@ -468,8 +484,9 @@ class Engine:
                 if not req.ticket.done:
                     err = RequestQuarantinedError(
                         f"request {req.rid} deterministically fails its "
-                        f"batch (bucket {plan.bucket}) — quarantined by "
-                        "bisection; batchmates completed separately")
+                        f"batch (bucket {plan.bucket}) on {self._rname} — "
+                        "quarantined by bisection; batchmates completed "
+                        "separately")
                     err.__cause__ = exc
                     self.quarantined.append(req.rid)
                     self.stats["quarantined"] += 1
@@ -525,13 +542,14 @@ class Engine:
                 continue
             err = RequestFailedError(
                 f"batch {stage} failed for request {req.rid} "
-                f"(bucket {plan.bucket}): {exc!r}")
+                f"(bucket {plan.bucket}, {self._rname}): {exc!r}")
             err.__cause__ = exc
             self._fail_request(req, err)
 
     # ----------------------------------------------------- watchdog / drain
 
     def _mark(self, label: str, budget_s: Optional[float] = None) -> None:
+        self._last_mark = (time.monotonic(), label)
         wd = self._wd
         if wd is not None:
             wd.mark(label, budget_s)
@@ -544,9 +562,9 @@ class Engine:
         self._stalled = True
         self.stats["stalls"] += 1
         err = EngineStalledError(
-            f"engine made no progress for {silent:.1f}s after {label!r} — "
-            "wedged backend; in-flight and queued tickets failed, results "
-            "fetched before the stall stand")
+            f"{self._rname} made no progress for {silent:.1f}s after "
+            f"{label!r} — wedged backend; in-flight and queued tickets "
+            "failed, results fetched before the stall stand")
         with self._lock:
             open_reqs = list(self._open.values())
         for req in open_reqs:
@@ -556,16 +574,27 @@ class Engine:
         """Graceful shutdown: stop admission (``submit`` raises
         :class:`EngineClosedError`), let an active :meth:`run` flush its
         in-flight batches, then deterministically fail everything still
-        queued. Returns the final health snapshot."""
+        queued. Returns the final health snapshot plus ``"idle"``.
+
+        When the idle wait TIMES OUT (``idle: False``) a :meth:`run` is
+        still mid-flight, so the queued-request sweep is skipped — failing
+        requests while their batches are on the device would race delivery
+        and could resolve a ticket the pipeline is about to complete. The
+        caller decides: wait again, or escalate (the fleet router treats a
+        non-idle drain as a wedged replica)."""
         with self._lock:
             self._closed = True
-        self._idle.wait(timeout)
-        with self._lock:
-            pending, self._pending = self._pending, []
-        for req in pending:
-            self._fail_request(req, EngineClosedError(
-                f"engine drained with request {req.rid} still queued"))
-        return self.health()
+        idle = self._idle.wait(timeout)
+        if idle:
+            with self._lock:
+                pending, self._pending = self._pending, []
+            for req in pending:
+                self._fail_request(req, EngineClosedError(
+                    f"{self._rname} drained with request {req.rid} "
+                    "still queued"))
+        report = self.health()
+        report["idle"] = idle
+        return report
 
     def health(self) -> dict:
         """Live health snapshot (also rendered into Ticket timeout
@@ -574,10 +603,16 @@ class Engine:
         with self._lock:
             depth = len(self._pending)
             open_n = len(self._open)
+            mark_t, _ = self._last_mark
+        now = time.monotonic()
         s = self.stats
         return {
+            "replica": self.replica_id,
             "queue_depth": depth,
             "open_tickets": open_n,
+            "max_queue": self.max_queue,
+            "uptime_s": now - self._t0,
+            "last_progress_s": now - mark_t,
             "running": self._running,
             "closed": self._closed,
             "stalled": self._stalled,
@@ -624,8 +659,8 @@ class Engine:
                 if closed:
                     for req in pending:
                         self._fail_request(req, EngineClosedError(
-                            f"engine drained with request {req.rid} still "
-                            "queued"))
+                            f"{self._rname} drained with request {req.rid} "
+                            "still queued"))
                     break
                 if not pending:
                     break
@@ -683,7 +718,8 @@ class Engine:
                 self.stats["deadline_expired"] += 1
                 self._fail_request(req, DeadlineExceeded(
                     f"request {req.rid} missed its deadline while queued "
-                    f"(expired {now - req.deadline:.3f}s before planning)"))
+                    f"on {self._rname} (expired {now - req.deadline:.3f}s "
+                    "before planning)"))
             else:
                 live.append(req)
         return live

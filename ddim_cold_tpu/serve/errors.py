@@ -11,7 +11,7 @@ liveness contract the chaos tests pin.
 
 from __future__ import annotations
 
-from ddim_cold_tpu.utils.faults import TransientFault
+from ddim_cold_tpu.utils.faults import TRANSIENT_EXCEPTIONS
 
 
 class ServeError(Exception):
@@ -49,7 +49,10 @@ class EngineStalledError(ServeError):
     fail with this; batches fetched before the stall keep their results."""
 
 
-#: Exception classes the dispatch path treats as retryable (capped
-#: exponential backoff) rather than deterministic. Transfer/RPC-class
-#: failures recover on retry; anything else goes straight to bisection.
-RETRYABLE_EXCEPTIONS: tuple = (TransientFault, ConnectionError)
+#: Exception classes the dispatch path (and the fleet router's hedging)
+#: treats as retryable (capped exponential backoff / one hedged
+#: re-placement) rather than deterministic. Built from the fault
+#: registry's own transient table plus the real transfer/RPC class, so a
+#: new transient fault kind is retryable by construction; anything else
+#: goes straight to bisection.
+RETRYABLE_EXCEPTIONS: tuple = TRANSIENT_EXCEPTIONS + (ConnectionError,)

@@ -1,0 +1,185 @@
+"""Replica handles — the fleet's unit of lifecycle management.
+
+A :class:`ReplicaHandle` is what the router needs from one serving replica:
+warm it, hand it requests, read its health, drain it, kill it. The surface
+is deliberately narrow and host-typed (dicts, numpy-backed tickets) so a
+subprocess or remote-host backend can slot in behind the same interface —
+the router never sees an Engine, a mesh, or a device array.
+
+:class:`LocalReplica` is the in-process backend: one
+:class:`~ddim_cold_tpu.serve.engine.Engine` plus a worker thread that runs
+the engine's dispatch loop whenever the queue is non-empty, so ``submit``
+returns immediately and N replicas serve concurrently inside one process
+(their device work still serializes on one backend — the point here is
+failure isolation and lifecycle, not extra FLOPs; a subprocess backend
+buys the parallelism later without touching the router).
+
+Lifecycle is a one-way street::
+
+    new --warm()--> ready --drain()--> draining --> closed
+
+The router only places onto ``ready`` replicas; ``drain()`` stops the
+worker after the engine's own graceful drain (which fails still-queued
+tickets with :class:`~ddim_cold_tpu.serve.errors.EngineClosedError` — the
+router's cue to fail those requests over to surviving replicas).
+
+This module is host-only (graftcheck A004): no jax imports — the engine
+and warmup are imported lazily inside :func:`local_factory` so importing
+the fleet layer never initializes a backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence
+
+#: replica lifecycle states (a handle only ever moves forward through these)
+NEW, READY, DRAINING, CLOSED = "new", "ready", "draining", "closed"
+
+
+class ReplicaHandle:
+    """The router's view of one replica. Subclass per backend; every method
+    is called from the router's control thread (plus ``submit`` from the
+    router under its own lock), so implementations need to be thread-safe
+    against their OWN worker, not against concurrent router calls."""
+
+    replica_id: str = ""
+    state: str = NEW
+
+    def warm(self, configs, buckets=None, **kwargs) -> dict:
+        """Compile every (config, bucket) program; flips state to ready.
+        After this, ``health()['compiles_after_warmup']`` must stay 0 for
+        the replica's lifetime — the fleet-wide zero-compile contract."""
+        raise NotImplementedError
+
+    def start(self) -> None:
+        """Begin serving (idempotent)."""
+        raise NotImplementedError
+
+    def submit(self, *args, **kwargs):
+        """Queue one request; returns its Ticket. Raises the engine's
+        admission errors (QueueFullError / EngineClosedError)."""
+        raise NotImplementedError
+
+    def health(self) -> dict:
+        """Engine health snapshot plus ``state`` and
+        ``compiles_after_warmup`` (the two fleet-level fields)."""
+        raise NotImplementedError
+
+    def drain(self, timeout: Optional[float] = None) -> dict:
+        """Graceful stop: engine drain (queued tickets fail typed), worker
+        stopped, state → closed. Returns the drain report."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Hard stop (drain with a short timeout)."""
+        raise NotImplementedError
+
+
+class LocalReplica(ReplicaHandle):
+    """In-process replica: an Engine plus its serving thread.
+
+    The worker loop polls the engine queue every ``poll_s`` (and wakes
+    immediately on ``submit``), calling :meth:`Engine.run` whenever work is
+    pending — requests submitted mid-run join the run's next planning
+    round, so the loop is a thin liveness shim, not a scheduler.
+    """
+
+    def __init__(self, engine, *, poll_s: float = 0.02, join_s: float = 5.0):
+        self.engine = engine
+        self.replica_id = engine.replica_id
+        self.state = NEW
+        self.poll_s = float(poll_s)
+        self.join_s = float(join_s)
+        self.warmup_compiles = 0
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def warm(self, configs, buckets=None, **kwargs) -> dict:
+        from ddim_cold_tpu.serve.warmup import warmup
+
+        report = warmup(self.engine, configs, buckets, **kwargs)
+        self.warmup_compiles = self.engine.stats["compiles"]
+        self.state = READY
+        return report
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name=f"replica-{self.replica_id}",
+                daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._work.wait(self.poll_s)
+            self._work.clear()
+            if self.engine.queue_depth():
+                try:
+                    self.engine.run()
+                except Exception:  # noqa: BLE001 — run() isolates failures
+                    # per batch; anything escaping it must not kill the
+                    # worker (the router retires the replica via health())
+                    pass
+
+    def drain(self, timeout: Optional[float] = None) -> dict:
+        self.state = DRAINING
+        report = self.engine.drain(timeout)
+        self._stop.set()
+        self._work.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            # bounded join: a wedged engine (report["idle"] False) can pin
+            # the worker forever — it is a daemon thread, leave it behind
+            thread.join(self.join_s)
+        self.state = CLOSED
+        return report
+
+    def close(self) -> None:
+        if self.state != CLOSED:
+            self.drain(self.join_s)
+
+    # -------------------------------------------------------------- serving
+
+    def submit(self, *args, **kwargs):
+        ticket = self.engine.submit(*args, **kwargs)
+        self._work.set()
+        return ticket
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    @property
+    def compiles_after_warmup(self) -> int:
+        """Program builds since this replica's own warmup — the per-replica
+        zero-compile contract (a replacement replica proves 0 against its
+        OWN warm, not the fleet's first)."""
+        return self.engine.stats["compiles"] - self.warmup_compiles
+
+    def health(self) -> dict:
+        h = self.engine.health()
+        h["state"] = self.state
+        h["compiles_after_warmup"] = self.compiles_after_warmup
+        return h
+
+
+def local_factory(model, params, *, mesh=None,
+                  **engine_kwargs) -> Callable[[str], LocalReplica]:
+    """Factory of in-process replicas for :class:`~.router.Router`:
+    ``factory(replica_id)`` builds an Engine (with that id threaded into
+    its fault tags and failure messages) wrapped in a started-on-demand
+    :class:`LocalReplica`. All replicas share the caller's ``params``
+    (jax arrays are immutable — sharing is safe and keeps N replicas at
+    one param footprint)."""
+    def factory(replica_id: str) -> LocalReplica:
+        from ddim_cold_tpu.serve.engine import Engine
+
+        return LocalReplica(Engine(model, params, mesh=mesh,
+                                   replica_id=replica_id, **engine_kwargs))
+    return factory

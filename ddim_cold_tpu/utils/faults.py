@@ -49,7 +49,8 @@ ENV_VAR = "DDIM_COLD_FAULTS"
 #: the named fault sites (typo guard for specs; ``fire`` itself accepts any
 #: string so a site can be added where it is fired before it is listed here)
 SITES = ("serve.assemble", "serve.dispatch", "serve.fetch", "serve.compile",
-         "ckpt.save", "data.next")
+         "ckpt.save", "data.next",
+         "router.place", "router.failover", "replica.spawn")
 KINDS = ("transient", "permanent", "latency", "corrupt")
 
 
@@ -63,6 +64,17 @@ class TransientFault(FaultError):
 
 class PermanentFault(FaultError):
     """Injected deterministic fault (fails every retry the same way)."""
+
+
+#: What each raising kind throws (``latency``/``corrupt`` never raise).
+#: serve/errors.py derives RETRYABLE_EXCEPTIONS from TRANSIENT_EXCEPTIONS so
+#: a new retryable kind added here cannot silently become non-retryable —
+#: tests/test_faults.py pins the two tables against each other.
+KIND_EXCEPTIONS: dict = {"transient": TransientFault,
+                         "permanent": PermanentFault}
+
+#: The transient (retry-recoverable) fault classes this module can raise.
+TRANSIENT_EXCEPTIONS: tuple = (TransientFault,)
 
 
 @dataclass(frozen=True)

@@ -137,6 +137,20 @@ def render(path: str) -> str:
               f"{fl.get('quarantined')} · compiles after warmup "
               f"{fl.get('compiles_after_warmup')}")
 
+    ft = sub.get("fleet")
+    if ft:
+        lines.append("")
+        lines.append(
+            f"**fleet:** {ft.get('replicas')} replicas · clean "
+            f"{ft.get('clean_img_per_sec')} img/s · chaos "
+            f"{ft.get('chaos_img_per_sec')} img/s "
+            f"({ft.get('degraded_ratio')}× clean) under "
+            f"{ft.get('injected')} injections {ft.get('by_site')} · "
+            f"hedges {ft.get('hedges')} · failovers {ft.get('failovers')} · "
+            f"replicas retired {ft.get('replicas_retired')}/spawned "
+            f"{ft.get('replicas_spawned')} · compiles after warmup "
+            f"{ft.get('compiles_after_warmup')}")
+
     for key, label in (("cached_quality_64px", "cached quality 64px"),
                        ("quant_quality_64px", "w8a16 quality 64px"),
                        ("quant_cached_quality_64px",

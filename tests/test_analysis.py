@@ -351,3 +351,18 @@ def test_clean_tree_full_collect():
     the same three layers CI's `graftcheck --baseline` run enforces."""
     fs = cli.collect(cli.repo_root())
     assert [f.render() for f in fs] == []
+
+
+def test_fleet_layer_is_covered_by_a003_and_a004():
+    """The fleet layer stays inside the static net: router.py/fleet.py are
+    host-only modules (A004 — routing must never touch a device array) and
+    every router fault site is registered (A003 — a typo'd site string
+    would silently never fire)."""
+    from ddim_cold_tpu.utils import faults
+
+    for mod in ("ddim_cold_tpu/serve/router.py",
+                "ddim_cold_tpu/serve/fleet.py",
+                "ddim_cold_tpu/serve/batching.py"):
+        assert mod in ast_checks.HOST_ONLY_MODULES, mod
+    for site in ("router.place", "router.failover", "replica.spawn"):
+        assert site in faults.SITES, site

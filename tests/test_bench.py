@@ -292,3 +292,27 @@ def test_bench_fatal_error_still_emits_partial_record():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "fatal_error" in rec["submetrics"], rec
     assert rec["metric"] == "train_throughput_vit_tiny64_b32"
+
+
+def test_bench_fleet_smoke_record(capsys):
+    """The --fleet leg: a 2-replica router serves the stream clean, then
+    under the seeded chaos schedule that kills r0 and sprays transients —
+    the record must show the fleet surviving (throughput, not outage), the
+    replica replacement, and ZERO compiles after warmup including the
+    replacement's service life."""
+    import bench
+
+    bench.main(["--smoke", "--cpu", "--steps", "3", "--batch", "4",
+                "--skip-sampler", "--no-ksweep", "--fleet"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    fl = rec["submetrics"]["fleet"]
+    assert fl["compiles_after_warmup"] == 0  # replacement included
+    assert np.isfinite(fl["clean_img_per_sec"]) and fl["clean_img_per_sec"] > 0
+    assert np.isfinite(fl["chaos_img_per_sec"]) and fl["chaos_img_per_sec"] > 0
+    assert fl["survivors"] >= 1  # the kill degraded, never silenced, serving
+    assert fl["survivors"] + fl["failed_tickets"] == len(fl["stream_sizes"])
+    # r0's permanent kill fired, and the lifecycle ran: retire + respawn
+    assert fl["injected"] >= 1 and "serve.dispatch" in fl["by_site"]
+    assert fl["replicas_retired"] >= 1
+    assert fl["replicas_spawned"] >= 3  # 2 initial + the replacement

@@ -281,3 +281,34 @@ def test_data_next_site_fires_in_loader():
     loader2 = ShardedLoader(Toy(), batch_size=4, shuffle=False,
                             num_threads=2)
     assert sum(1 for _ in loader2) == 2
+
+
+# ------------------------------------------------------- retry taxonomy
+
+
+def test_retryable_exceptions_match_transient_fault_kinds():
+    """The router's hedging predicate (errors.RETRYABLE_EXCEPTIONS) must
+    agree with this module's fault taxonomy: every raising kind is
+    classified, transients (and only transients) are retryable, and the
+    classification is derived — not a hand-copied list that drifts when a
+    kind is added."""
+    from ddim_cold_tpu.serve.errors import RETRYABLE_EXCEPTIONS
+
+    # every fault kind that raises has a classification entry
+    raising = set(faults.KIND_EXCEPTIONS)
+    assert raising == {"transient", "permanent"}
+    assert set(faults.KINDS) >= raising  # latency/corrupt perturb, not raise
+    # transients are exactly the retryable fault classes...
+    assert set(faults.TRANSIENT_EXCEPTIONS) == \
+        {faults.KIND_EXCEPTIONS["transient"]}
+    fault_retryables = tuple(e for e in RETRYABLE_EXCEPTIONS
+                             if issubclass(e, faults.FaultError))
+    assert fault_retryables == faults.TRANSIENT_EXCEPTIONS
+    # ...and permanents are terminal
+    assert not issubclass(PermanentFault,
+                          tuple(RETRYABLE_EXCEPTIONS))
+    # the classification is live: each raising kind raises its mapped class
+    for kind, exc_type in faults.KIND_EXCEPTIONS.items():
+        with faults.inject(FaultSpec("serve.dispatch", kind, rate=1.0)):
+            with pytest.raises(exc_type):
+                faults.fire("serve.dispatch")

@@ -11,6 +11,7 @@ tolerance as the sampler's own mesh tests)."""
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import jax
@@ -539,3 +540,85 @@ def test_disarmed_serving_is_bitwise_and_compile_free(model_and_params,
     np.testing.assert_array_equal(t.result(timeout=5),
                                   _direct(model, params, 450, 6))
     assert eng.stats["compiles"] == compiles
+
+
+# ----------------------------------------------------- fleet satellites
+#
+# Engine-level pieces the replica router (serve/router.py) builds on: the
+# drain(timeout) idle-report fix, the health() snapshot fields supervision
+# reads, and replica-id threading through failure messages and fault tags.
+
+
+def test_drain_timeout_skips_sweep_when_not_idle(model_and_params):
+    """drain(timeout) against a mid-flight run reports idle=False and does
+    NOT sweep the queue — the old code dropped the wait's return and failed
+    queued requests while their batches were still on the device. Liveness
+    still holds: the run itself fails what it finds queued after close."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,))
+    cfg = serve.SamplerConfig(k=K)
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    a = eng.submit(seed=460, n=2, config=cfg)
+    with faults.inject(faults.FaultSpec("serve.dispatch", "latency",
+                                        latency_s=0.5, max_fires=1)):
+        worker = threading.Thread(target=eng.run, daemon=True)
+        worker.start()
+        deadline = time.time() + 5
+        while (eng.queue_depth() > 0 or not eng.health()["running"]) \
+                and time.time() < deadline:
+            time.sleep(0.005)  # wait until the run owns request a
+        b = eng.submit(seed=461, n=1, config=cfg)  # queued behind the run
+        report = eng.drain(timeout=0.05)
+        assert report["idle"] is False
+        assert not a.done and not b.done  # sweep skipped, nothing raced
+        worker.join(timeout=10)
+    # the run flushed a (bitwise) and failed b typed on seeing closed
+    np.testing.assert_array_equal(a.result(timeout=5),
+                                  _direct(model, params, 460, 2))
+    assert isinstance(b.exception(timeout=5), serve.EngineClosedError)
+    assert eng.drain(timeout=5)["idle"] is True  # settled now
+
+
+def test_health_has_supervision_fields(model_and_params):
+    """health() carries what fleet supervision needs without touching the
+    engine: replica identity, max_queue (admission headroom), uptime_s, and
+    last_progress_s (wedge detection from a snapshot alone)."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,), max_queue=5,
+                       replica_id="rX")
+    h = eng.health()
+    assert h["replica"] == "rX" and h["max_queue"] == 5
+    assert h["uptime_s"] >= 0 and h["last_progress_s"] >= 0
+    time.sleep(0.05)
+    cfg = serve.SamplerConfig(k=K)
+    t = eng.submit(seed=470, n=1, config=cfg)
+    eng.run()
+    assert t.result(timeout=30) is not None
+    h2 = eng.health()
+    assert h2["uptime_s"] > h["uptime_s"]
+    # the run just made progress: its age is far below the engine's
+    assert h2["last_progress_s"] < h2["uptime_s"]
+    assert h2["last_progress_s"] < 0.05 + h2["uptime_s"] - h["uptime_s"]
+
+
+def test_replica_id_in_failure_messages_and_fault_tags(model_and_params):
+    """A replica-scoped engine names itself in every failure message (so a
+    fleet-level error is attributable) and prefixes its fault tags with
+    replica:<id>| (so chaos schedules can target one replica)."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,), replica_id="r9")
+    cfg = serve.SamplerConfig(k=K)
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    with faults.inject(faults.FaultSpec("serve.dispatch", "permanent",
+                                        match="replica:r9|")) as plan:
+        t = eng.submit(seed=480, n=1, config=cfg)
+        eng.run()
+        exc = t.exception(timeout=5)
+    assert isinstance(exc, serve.RequestQuarantinedError)
+    assert "replica 'r9'" in str(exc)
+    assert plan.realized and all(
+        r["tag"].startswith("replica:r9|") for r in plan.realized)
+    # drain-path message carries the id too
+    t2 = eng.submit(seed=481, n=1, config=cfg)
+    eng.drain(timeout=1)
+    assert "replica 'r9'" in str(t2.exception(timeout=5))
