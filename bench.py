@@ -190,6 +190,16 @@ def main(argv=None):
                          "compiles-after-warmup (MUST be 0, replacement "
                          "included); composes with --smoke for a CPU-budget "
                          "run")
+    ap.add_argument("--edit", action="store_true",
+                    help="run the guided-editing workloads leg "
+                         "(ddim_cold_tpu/workloads): all four tasks "
+                         "(inpaint, superres, draft, interp) served through "
+                         "one engine after a single warmup — per-task "
+                         "sustained img/s, then a preview-enabled drain "
+                         "recording latency-to-first-frame for the streamed "
+                         "x̂0 previews; raises if any task or the preview "
+                         "variant compiles after warmup; composes with "
+                         "--smoke for a CPU-budget run")
     ap.add_argument("--quant", action="store_true",
                     help="run the w8a16 quantized-inference legs "
                          "(ops/quant.py): 64px sampler in both dequant-matmul "
@@ -886,6 +896,128 @@ def main(argv=None):
 
         if args.fleet:
             section("fleet", run_fleet)
+
+        def run_edit():
+            # the guided-editing leg (ddim_cold_tpu/workloads): every task
+            # is a SamplerConfig variant through the SAME engine, so one
+            # warmup covers all four (task, bucket) program families plus
+            # the preview-enabled variant. Each task then drains its own
+            # mixed stream (per-task img/s — the padding/coalescing story
+            # per workload), and a preview drain records
+            # latency-to-first-frame: how long before the user sees the
+            # first streamed x̂0 frame, against total completion. The
+            # compile counter MUST stay frozen across all of it — edits
+            # and previews reuse warmed programs — and the leg raises if
+            # that contract breaks.
+            from ddim_cold_tpu import serve, workloads
+
+            buckets = (2, 4) if args.smoke else (8, 32)
+            k_serve = 400 if args.smoke else 20
+            t_edit = 1200 if args.smoke else 1800
+            sr_level, pv_every = 3, 2
+            bmax = max(buckets)
+            H, W = model.img_size
+            cfgs = {c.task: c for c in workloads.default_edit_configs(
+                k=k_serve, t_start=t_edit, sr_level=sr_level)}
+            pv_cfg = serve.SamplerConfig(task="draft", k=k_serve,
+                                         t_start=t_edit,
+                                         preview_every=pv_every)
+            engine = serve.Engine(model, state.params, buckets=buckets)
+            mark(f"edit warmup buckets={buckets}", budget_s=2 * stall_s)
+            wu = serve.warmup(engine, list(cfgs.values()) + [pv_cfg])
+            r9 = np.random.RandomState(9)
+            imgs = np.clip(r9.randn(bmax, H, W, model.in_chans),
+                           -1.0, 1.0).astype(np.float32)
+            m = np.zeros((H, W), np.float32)
+            m[: H // 2] = 1.0  # top half known, bottom half synthesized
+            low = imgs[:, ::2 ** sr_level, ::2 ** sr_level]  # the cold
+            # operator itself — nearest-downsample at sr_level
+            # one full bucket + a coalesced pair summing to a bucket: the
+            # per-task number includes the packing machinery, zero pad rows
+            sizes = [bmax, bmax // 2, bmax // 2]
+
+            def submit_task(task, cfg, i, n_req):
+                if task == "inpaint":
+                    return engine.submit(seed=700 + i, x_init=imgs[:n_req],
+                                         mask=m, config=cfg)
+                if task == "superres":
+                    return engine.submit(
+                        x_init=workloads.superres_init(low[:n_req], H),
+                        config=cfg)
+                if task == "draft":
+                    return engine.submit(seed=700 + i, x_init=imgs[:n_req],
+                                         config=cfg)
+                # interp: x_init is the endpoint PAIR, n the path length
+                return engine.submit(seed=700 + i, n=n_req,
+                                     x_init=imgs[:2], config=cfg)
+
+            per_task = {}
+            compiles = 0
+            for task, cfg in cfgs.items():
+                best = None
+                for rep in range(2):  # keep the faster drain (time_ddim's rule)
+                    mark(f"edit drain {task} rep {rep}")
+                    for i, n_req in enumerate(sizes):
+                        submit_task(task, cfg, i, n_req)
+                    r = engine.run()
+                    if best is None or r["img_per_sec"] > best["img_per_sec"]:
+                        best = r
+                    compiles += r["compiles"]
+                per_task[task] = {
+                    "img_per_sec": round(best["img_per_sec"], 2),
+                    "rows": best["rows"], "batches": best["batches"]}
+                log(f"edit {task}: {best['img_per_sec']:.2f} img/s over "
+                    f"{best['rows']} rows ({best['batches']} batches)")
+            # preview drain: TWO full-bucket draft requests streaming x̂0
+            # frames — previews are delivered per finished batch, so the
+            # first request's frames arrive while the second batch is still
+            # computing. The first callback firing stamps
+            # latency-to-first-frame; against the total drain wall it is
+            # the streaming story (a single-request drain would put the
+            # first frame at ≈100% of its own wall by construction).
+            first = {}
+            mark("edit preview drain")
+            t0 = time.perf_counter()
+            tickets = [engine.submit(seed=900 + i, x_init=imgs[:bmax],
+                                     config=pv_cfg) for i in range(2)]
+            for t in tickets:
+                t.add_preview_callback(
+                    lambda step, frames: first.setdefault(
+                        "s", time.perf_counter()))
+            r = engine.run()
+            total_s = time.perf_counter() - t0
+            compiles += r["compiles"]
+            n_frames = sum(sum(1 for _ in t.previews()) for t in tickets)
+            first_s = (first["s"] - t0) if first else None
+            sub["edit"] = {
+                "per_task": per_task,
+                "preview": {
+                    "every": pv_every, "frames": n_frames,
+                    "latency_to_first_frame_s":
+                        None if first_s is None else round(first_s, 4),
+                    "total_s": round(total_s, 4),
+                    "first_frame_fraction":
+                        None if first_s is None or not total_s
+                        else round(first_s / total_s, 3)},
+                "compiles_after_warmup": compiles,
+                "warmup_new_compiles": wu["new_compiles"],
+                "warmup_programs": wu["programs"],
+                "stream_sizes": sizes, "buckets": list(buckets),
+                "k": k_serve, "t_start": t_edit, "sr_level": sr_level,
+            }
+            log(f"edit preview: first frame at "
+                f"{first_s if first_s is None else round(first_s, 3)}s of "
+                f"{total_s:.3f}s total ({n_frames} frames); compiles after "
+                f"warmup: {compiles}")
+            if compiles != 0 or n_frames < 1:
+                raise RuntimeError(
+                    "edit-serving contract broken: "
+                    f"{compiles} compiles after warmup, {n_frames} preview "
+                    "frames (need 0 compiles and ≥1 frame before "
+                    "completion)")
+
+        if args.edit:
+            section("edit", run_edit)
 
         def run_quant64():
             # w8a16 sampler legs at 64px (ops/quant.py), both dequant-matmul

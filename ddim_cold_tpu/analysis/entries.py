@@ -33,7 +33,11 @@ K = 500    # the 4-reverse-step stride test_serve.py warms
 N = 4      # batch rows for the non-serve entries
 
 #: the warmed (SamplerConfig, buckets) sweep tests/test_serve.py +
-#: tests/test_quant.py cover — built lazily (SamplerConfig import)
+#: tests/test_quant.py + tests/test_workloads.py cover — built lazily
+#: (SamplerConfig import). Entries must differ STRUCTURALLY (trip count,
+#: function identity, quant, sequence flag, avals) — signature_hash does
+#: not see constant values, so e.g. two t_starts with the same step count
+#: would collide by design, not by bug.
 def serve_sweep():
     from ddim_cold_tpu.serve.batching import SamplerConfig
 
@@ -43,6 +47,31 @@ def serve_sweep():
         ("cold_l4", SamplerConfig(sampler="cold", levels=4), (4, 8)),
         ("ddim_k500_t999", SamplerConfig(k=K, t_start=999), (4, 8)),
         ("ddim_k500_qxla", SamplerConfig(k=K, quant="xla"), (4,)),
+        # editing workloads (ddim_cold_tpu/workloads) + preview variants:
+        # trip counts at K=500/T=2000 — t=None→4, t1200→3, t999→2, t400→1
+        ("ddim_k500_pv2", SamplerConfig(k=K, preview_every=2), (4, 8)),
+        ("ddim_k500_ci2_pv2",
+         SamplerConfig(k=K, cache_interval=2, preview_every=2), (4,)),
+        ("inpaint_k500", SamplerConfig(task="inpaint", k=K), (4, 8)),
+        ("inpaint_k500_qxla",
+         SamplerConfig(task="inpaint", k=K, quant="xla"), (4,)),
+        ("inpaint_k500_pv2",
+         SamplerConfig(task="inpaint", k=K, preview_every=2), (4,)),
+        ("superres_l3",
+         SamplerConfig(task="superres", sampler="cold", levels=3), (4, 8)),
+        ("superres_l3_ci2",
+         SamplerConfig(task="superres", sampler="cold", levels=3,
+                       cache_interval=2), (4,)),
+        ("superres_l3_pv1",
+         SamplerConfig(task="superres", sampler="cold", levels=3,
+                       preview_every=1), (4,)),
+        ("draft_k500_t1200",
+         SamplerConfig(task="draft", k=K, t_start=1200), (4, 8)),
+        ("draft_k500_t1200_ci2",
+         SamplerConfig(task="draft", k=K, t_start=1200, cache_interval=2),
+         (4,)),
+        ("interp_k500_t400",
+         SamplerConfig(task="interp", k=K, t_start=400), (4,)),
     ]
 
 
@@ -106,6 +135,10 @@ class Context:
                                           self.model.embed_dim,
                                           self.model.dtype))
 
+    def mask(self, n: int):
+        H, W = self.model.img_size
+        return jax.ShapeDtypeStruct((n, H, W, 1), jnp.float32)
+
 
 def build_entries(ctx: Context) -> list[Entry]:
     from ddim_cold_tpu.ops import quant, sampling
@@ -128,10 +161,18 @@ def build_entries(ctx: Context) -> list[Entry]:
                    sequence=False), donates=True),
         Entry("cold_scan", SAMP, sampling._cold_scan, (p, x), (m,),
               dict(levels=4, return_sequence=False), donates=True),
+        Entry("cold_scan_seq", SAMP, sampling._cold_scan_seq, (p, x), (m,),
+              dict(levels=4, return_sequence=True)),
         Entry("cold_scan_cached", SAMP, sampling._cold_scan_cached,
               (p, x, ctx.cache(N)), (m,),
               dict(levels=4, return_sequence=False, cache_interval=2,
                    cache_mode="delta"), donates=True),
+        Entry("ddim_scan_inpaint", SAMP, sampling._ddim_scan_inpaint,
+              (p, x, x, ctx.mask(N), key), (m,),
+              dict(ddim_kw, sequence=False), donates=True),
+        Entry("ddim_scan_inpaint_seq", SAMP, sampling._ddim_scan_inpaint_seq,
+              (p, x, x, ctx.mask(N), key), (m,),
+              dict(ddim_kw, sequence=True)),
         Entry("ddim_scan_last_w8a16", "ddim_cold_tpu/ops/quant.py",
               sampling._ddim_scan_last, (ctx.qparams, ctx.x(N), key),
               (ctx.qmodel,), dict(ddim_kw), donates=True),
@@ -182,28 +223,47 @@ def run_entry_checks(max_const_bytes: int = 1 << 20) -> list[Finding]:
 def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
     """The exact dispatch serve/engine.py's ``_build_program`` AOT-compiles
     for (config, bucket) — same functions, same statics, same aval shapes —
-    mirrored here so its trace identity is checked statically."""
+    mirrored here so its trace identity is checked statically. The task and
+    preview branches mirror too: inpaint has its own constrained scan (with
+    known/mask avals), ``preview_every > 0`` selects the sequence variant."""
     from ddim_cold_tpu.ops import sampling
 
     model = ctx.qmodel if config.quant else ctx.model
     params = ctx.qparams if config.quant else ctx.params
     x = ctx.x(bucket)
+    seq = config.preview_every > 0
+    if config.task == "inpaint":
+        H, W = ctx.model.img_size
+        mask = jax.ShapeDtypeStruct((bucket, H, W, 1), jnp.float32)
+        fn = (sampling._ddim_scan_inpaint_seq if seq
+              else sampling._ddim_scan_inpaint)
+        return Entry("serve", "", fn,
+                     (params, x, ctx.x(bucket), mask, ctx.key), (model,),
+                     dict(k=config.k, t_start=config.t_start, eta=0.0,
+                          sequence=seq))
     if config.sampler == "cold":
         if config.cached:
-            return Entry("serve", "", sampling._cold_scan_cached,
+            fn = (sampling._cold_scan_cached_seq if seq
+                  else sampling._cold_scan_cached)
+            return Entry("serve", "", fn,
                          (params, x, ctx.cache(bucket)), (model,),
-                         dict(levels=config.levels, return_sequence=False,
+                         dict(levels=config.levels, return_sequence=seq,
                               cache_interval=config.cache_interval,
                               cache_mode=config.cache_mode))
-        return Entry("serve", "", sampling._cold_scan, (params, x), (model,),
-                     dict(levels=config.levels, return_sequence=False))
+        fn = sampling._cold_scan_seq if seq else sampling._cold_scan
+        return Entry("serve", "", fn, (params, x), (model,),
+                     dict(levels=config.levels, return_sequence=seq))
     if config.cached:
-        return Entry("serve", "", sampling._ddim_scan_cached,
+        fn = (sampling._ddim_scan_cached_seq if seq
+              else sampling._ddim_scan_cached)
+        return Entry("serve", "", fn,
                      (params, x, ctx.key, ctx.cache(bucket)), (model,),
                      dict(k=config.k, t_start=config.t_start, eta=0.0,
                           cache_interval=config.cache_interval,
-                          cache_mode=config.cache_mode, sequence=False))
-    return Entry("serve", "", sampling._ddim_scan_last,
+                          cache_mode=config.cache_mode, sequence=seq))
+    fn = (sampling._ddim_scan_sequence if seq
+          else sampling._ddim_scan_last)
+    return Entry("serve", "", fn,
                  (params, x, ctx.key), (model,),
                  dict(k=config.k, t_start=config.t_start, eta=0.0))
 

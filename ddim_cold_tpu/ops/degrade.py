@@ -49,6 +49,26 @@ def cold_degrade(imgs: jax.Array, t: jax.Array, *, size: int, max_step: int = 6)
     return jax.vmap(one)(imgs, t.astype(jnp.int32))
 
 
+def upsample_nearest(imgs: jax.Array, size: int) -> jax.Array:
+    """Nearest-upsample (B, h, w, C) → (B, size, size, C), torch convention.
+
+    The "up" half of the cold degradation on its own: for a low-res image
+    ``lo = nearest-downsample(x, level)``, ``upsample_nearest(lo, size)`` IS
+    ``cold_degrade(x, level)`` — the degraded full-size state the cold scan
+    starts from. The super-resolution workload (ddim_cold_tpu/workloads)
+    uses exactly this to lift a user's low-res input into the sampler's
+    state space; the index math matches the host path bit-for-bit, so a
+    constant-color 1×1 input reproduces ``cold_sample``'s broadcast init
+    exactly (the equivalence test in tests/test_workloads.py).
+    """
+    imgs = jnp.asarray(imgs, jnp.float32)
+    if imgs.ndim == 3:
+        imgs = imgs[None]
+    iy = jnp.asarray(nearest_indices(size, imgs.shape[1]))
+    ix = jnp.asarray(nearest_indices(size, imgs.shape[2]))
+    return imgs[:, iy][:, :, ix]
+
+
 def normalize_base(base: jax.Array) -> jax.Array:
     """Raw base image → float32 in [−1, 1] with the host pipeline's exact op
     order (÷255 then ·2−1, datasets._load_base) so a uint8-shipped batch is

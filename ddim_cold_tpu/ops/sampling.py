@@ -157,6 +157,53 @@ _ddim_scan_cached_seq = jax.jit(_ddim_cached_impl,
                                 static_argnames=_CACHED_STATICS)
 
 
+def _ddim_inpaint_impl(model, params, x_init, known, mask, noise_rng, *,
+                       k: int, t_start: Optional[int], eta: float,
+                       sequence: bool):
+    """The inpainting scan (ddim_cold_tpu/workloads): plain DDIM with a
+    per-step constraint — after each x̂0 prediction, the KNOWN pixels are
+    re-projected from the reference image (``x̂0 ← m·known + (1−m)·x̂0``)
+    before the affine update, so the reverse process is pulled toward a
+    sample whose masked region agrees with ``known`` exactly. ``mask`` is a
+    static-shaped (N, H, W, 1) float batch input of {0, 1} (1 = known); the
+    projection is per-row, so the engine's coalescing keeps the bitwise
+    contract, and padding rows (mask 0) pass through untouched. The final
+    output is the LAST projected x̂0, hence known pixels are preserved
+    bit-exactly (mask idempotence — tests/test_workloads.py pins it).
+    ``sequence=True`` returns the (steps+1, N, H, W, C) trajectory of
+    projected x̂0 predictions (the preview path)."""
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, _ = carry
+        t, c1, c2, cz = inputs
+        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        x0 = jnp.clip(x0, -1.0, 1.0)
+        x0 = mask * known + (1.0 - mask) * x0
+        return (_ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta),
+                x0), (x0 if sequence else None)
+
+    (_, x0_last), x0_out = jax.lax.scan(
+        step, (x_init, jnp.zeros_like(x_init)), _scan_inputs(coeffs))
+    if sequence:
+        frames = jnp.concatenate([x_init[None], x0_out], axis=0)
+        return (frames + 1.0) / 2.0
+    return (x0_last + 1.0) / 2.0
+
+
+_INPAINT_STATICS = ("model", "k", "t_start", "eta", "sequence")
+#: last-only entry donates x_init (fresh noise, image output aliases it);
+#: ``known``/``mask`` are caller-owned conditioning inputs and never donate.
+_ddim_scan_inpaint = jax.jit(_ddim_inpaint_impl,
+                             static_argnames=_INPAINT_STATICS,
+                             donate_argnames=("x_init",))
+#: sequence entry — no donation (frames alias nothing), mirroring the other
+#: sequence scans.
+_ddim_scan_inpaint_seq = jax.jit(_ddim_inpaint_impl,
+                                 static_argnames=_INPAINT_STATICS)
+
+
 def _make_cache(model, x_init: jax.Array, mesh) -> step_cache.Cache:
     """Build the zero cache carry host-side and, under SPMD sampling, place
     it batch-sharded over the mesh's 'data' axis alongside the sample batch
@@ -253,6 +300,8 @@ def ddim_sample(
 def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
                 eta: float = 0.0,
                 rng: Optional[jax.Array] = None,
+                return_sequence: bool = False,
+                mesh=None,
                 cache_interval: int = 1,
                 cache_mode: str = "delta") -> jax.Array:
     """Guided sampling: DDIM-denoise an encoded image from level ``t_start``.
@@ -261,11 +310,14 @@ def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
     draft2drawing app composes this with ``forward_noise``; slerp interpolation
     (C25) composes it with a spherical mix of two encodings. ``eta`` > 0
     switches to stochastic DDIM (see ``ddim_sample``) and requires ``rng``.
-    ``cache_interval``/``cache_mode`` thread through to the feature-cached
-    sampler (see ``ddim_sample``).
+    ``return_sequence``/``mesh``/``cache_interval``/``cache_mode`` thread
+    through to ``ddim_sample`` (trajectory output, data-axis SPMD, and the
+    feature-cached sampler), so every guided composition — the editing
+    workloads in particular — reaches the same variants the plain sampler has.
     """
     return ddim_sample(model, params, rng, x_init=x_init, t_start=t_start,
-                       k=k, eta=eta, cache_interval=cache_interval,
+                       k=k, eta=eta, return_sequence=return_sequence,
+                       mesh=mesh, cache_interval=cache_interval,
                        cache_mode=cache_mode)
 
 
@@ -296,6 +348,23 @@ def slerp(a: jax.Array, b: jax.Array, frac: jax.Array) -> jax.Array:
     return jnp.where(sin < 1e-6, lin, wa * a + wb * b)
 
 
+def interp_states(rng: jax.Array, img_a: jax.Array, img_b: jax.Array,
+                  n_interp: int, t_start: int,
+                  total_steps: int = 2000) -> jax.Array:
+    """The slerp-mixed encodings :func:`slerp_interpolate` decodes: both
+    endpoints forward-noised to ``t_start`` with ONE key (independent noise
+    per endpoint — the batch draw covers both, matching the reference's two
+    separate draws ViT_draft2drawing.py:442-443), then ``n_interp``
+    great-circle fractions between the two encodings. Factored out so the
+    serving engine's interp workload (ddim_cold_tpu/workloads) builds
+    bit-identical init states to the direct call — row i depends only on
+    (key, endpoints, n_interp), never on its batchmates."""
+    batch = jnp.stack([img_a, img_b])
+    noisy = forward_noise(rng, batch, t_start, total_steps)
+    frac = jnp.linspace(0.0, 1.0, n_interp).reshape(-1, 1, 1, 1, 1)
+    return slerp(noisy[0][None], noisy[1][None], frac)[:, 0]
+
+
 def slerp_interpolate(
     model,
     params,
@@ -307,6 +376,7 @@ def slerp_interpolate(
     t_start: int = 1800,
     k: int = 10,
     eta: float = 0.0,
+    return_sequence: bool = False,
 ) -> jax.Array:
     """End-to-end latent interpolation (C25): encode both images to ``t_start``
     (one rng key, independent noise per endpoint — matching the reference's two
@@ -315,11 +385,10 @@ def slerp_interpolate(
     in [0, 1]. ``eta`` > 0 decodes stochastically (same semantics as
     :func:`sample_from`; the decode key is folded from ``rng`` so the
     encoding noise and the decode noise stay independent)."""
-    batch = jnp.stack([img_a, img_b])
-    noisy = forward_noise(rng, batch, t_start, model.total_steps)
-    frac = jnp.linspace(0.0, 1.0, n_interp).reshape(-1, 1, 1, 1, 1)
-    mixed = slerp(noisy[0][None], noisy[1][None], frac)[:, 0]
+    mixed = interp_states(rng, img_a, img_b, n_interp, t_start,
+                          model.total_steps)
     return sample_from(model, params, mixed, t_start=t_start, k=k, eta=eta,
+                       return_sequence=return_sequence,
                        rng=jax.random.fold_in(rng, 1))
 
 
@@ -390,10 +459,11 @@ _cold_scan_cached_seq = jax.jit(_cold_cached_impl,
 def cold_sample(
     model,
     params,
-    rng: jax.Array,
+    rng: Optional[jax.Array] = None,
     *,
     n: int = 49,
     levels: int = 6,
+    x_init: Optional[jax.Array] = None,
     return_sequence: bool = False,
     mesh=None,
     cache_interval: int = 1,
@@ -401,16 +471,28 @@ def cold_sample(
 ) -> jax.Array:
     """Cold-diffusion sampling from per-sample constant-color "noise".
 
-    The init is a single N(0,1) RGB color per sample broadcast over the image
-    (reference ViT_draft2drawing.py:264 — the fully-downsampled degenerate
-    state); ``levels`` defaults to 6 = log2(64). With a ``mesh``, the batch
-    runs SPMD sharded over its 'data' axis (see ``ddim_sample``).
+    The default init is a single N(0,1) RGB color per sample broadcast over
+    the image (reference ViT_draft2drawing.py:264 — the fully-downsampled
+    degenerate state); ``levels`` defaults to 6 = log2(64). Passing
+    ``x_init`` instead starts the cold scan from a caller-provided degraded
+    state at degradation level ``levels`` — the guided cold path (the
+    super-resolution workload feeds an upsampled low-res image here, with
+    ``levels`` = its downsampling level). With a ``mesh``, the batch runs
+    SPMD sharded over its 'data' axis (see ``ddim_sample``).
     ``cache_interval`` > 1 enables the feature-cached scan (see
     ``ddim_sample``); 1 is bit-for-bit the plain sampler.
     """
     H, W = model.img_size
-    color = jax.random.normal(rng, (n, 1, 1, model.in_chans), jnp.float32)
-    x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
+    if x_init is None:
+        if rng is None:
+            raise ValueError("cold_sample needs either rng or x_init")
+        color = jax.random.normal(rng, (n, 1, 1, model.in_chans), jnp.float32)
+        x_init = jnp.broadcast_to(color, (n, H, W, model.in_chans))
+    elif mesh is None and not return_sequence:
+        # the last-only cold scans DONATE x_init — a caller-provided start
+        # must survive the call (same private copy as ddim_sample's guided
+        # path; the mesh path copies via device_put, sequence never donates).
+        x_init = jnp.array(x_init, copy=True)
     x_init = _shard_init(x_init, mesh)
     if step_cache.enabled(cache_interval):
         fn = _cold_scan_cached_seq if return_sequence else _cold_scan_cached

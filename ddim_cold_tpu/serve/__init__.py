@@ -23,6 +23,12 @@ Quickstart::
 
 Engine output is bitwise identical to a direct ``ddim_sample``/``cold_sample``
 call with the same rng (padding rows discarded) — see engine.py for why.
+
+The guided-editing workloads (ddim_cold_tpu/workloads: inpaint, superres,
+draft, interp) serve through this same machinery as ``SamplerConfig(task=…)``
+variants — ``workloads.default_edit_configs()`` is the warmable set, and
+``SamplerConfig(preview_every=m)`` streams intermediate x̂0 frames through
+``Ticket.previews()``.
 """
 
 from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,

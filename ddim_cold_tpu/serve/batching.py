@@ -28,6 +28,10 @@ import numpy as np
 _SAMPLERS = ("ddim", "cold")
 _CACHE_MODES = ("delta", "full")
 _QUANT_MODES = (None, "xla", "pallas")  # ops/quant.py QUANT_MODES + off
+#: workloads.TASKS, duplicated as literals (this module is host-only —
+#: graftcheck A004 — and the workloads package imports jax); the two tuples
+#: are pinned equal by tests/test_workloads.py
+_TASKS = ("sample", "inpaint", "superres", "draft", "interp")
 
 
 @dataclass(frozen=True)
@@ -49,6 +53,15 @@ class SamplerConfig:
     cache_mode: str = "delta"
     quant: Optional[str] = None    # None = float params; "xla" | "pallas" =
     # the w8a16 trunk (ops/quant.py) over the engine's int8 param tree
+    task: str = "sample"           # "sample" = plain generation; an editing
+    # task name (ddim_cold_tpu/workloads) selects that task's init builder
+    # and — for "inpaint" — its per-step-constrained scan. Static: mixed
+    # tasks never coalesce, and the inpaint program has a different input
+    # signature (known + mask ride the batch).
+    preview_every: int = 0         # 0 = final result only; m > 0 streams
+    # every m-th intermediate x̂0 frame via Ticket.previews() — the engine
+    # then dispatches the SEQUENCE scan variant (a distinct program, part of
+    # the warmed set)
 
     def __post_init__(self):
         if self.sampler not in _SAMPLERS:
@@ -67,6 +80,30 @@ class SamplerConfig:
         if self.quant not in _QUANT_MODES:
             raise ValueError(f"quant must be one of {_QUANT_MODES}, "
                              f"got {self.quant!r}")
+        if self.task not in _TASKS:
+            raise ValueError(f"task must be one of {_TASKS}, "
+                             f"got {self.task!r}")
+        if self.preview_every < 0:
+            raise ValueError(f"preview_every must be >= 0, "
+                             f"got {self.preview_every}")
+        if self.task == "superres":
+            if self.sampler != "cold":
+                raise ValueError(
+                    "task 'superres' is the cold path (nearest-downsampling "
+                    "IS the cold degradation) — pass sampler='cold' with "
+                    "levels=<the input's downsampling level>")
+        elif self.task != "sample":
+            if self.sampler != "ddim":
+                raise ValueError(f"task {self.task!r} is a DDIM path, "
+                                 f"got sampler={self.sampler!r}")
+            if self.task in ("draft", "interp") and self.t_start is None:
+                raise ValueError(
+                    f"task {self.task!r} decodes from an intermediate noise "
+                    "level — t_start= is required")
+        if self.task == "inpaint" and self.cache_interval != 1:
+            raise ValueError(
+                "task 'inpaint' has no step-cached scan variant (the mask "
+                "projection lives in its own scan) — use cache_interval=1")
 
     @property
     def cached(self) -> bool:
@@ -94,6 +131,17 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self._health_cb = None  # engine attaches its health snapshot hook
         self._callbacks: list = []
+        # streaming previews (SamplerConfig.preview_every): per-step frame
+        # assembly (a split request's preview rows land batch by batch, like
+        # the result) + completed-frame history. _pcond serializes history
+        # and preview-callback registration so no frame is missed or
+        # double-fired; history keeps frames alive for late previews() /
+        # add_preview_callback consumers.
+        self._pcond = threading.Condition()
+        self._pbuf: dict = {}       # step -> [frame buffer, rows remaining]
+        self._pdone: set = set()    # completed steps (hedge dedupe)
+        self._phistory: list = []   # completed (step, frames), in order
+        self._preview_cbs: list = []
 
     def add_done_callback(self, fn) -> None:
         """Call ``fn(ticket)`` once, when the ticket resolves (completed OR
@@ -118,10 +166,86 @@ class Ticket:
         """Set the event and fire registered callbacks (resolver thread)."""
         self.done_time = time.perf_counter()
         self._event.set()
+        with self._pcond:
+            self._pcond.notify_all()  # previews() iterators stop at done
         with self._lock:
             cbs, self._callbacks = self._callbacks, []
         for fn in cbs:
             self._run_callback(fn)
+
+    # ------------------------------------------------------------ previews
+
+    def add_preview_callback(self, fn) -> None:
+        """Call ``fn(step, frames)`` for every COMPLETED preview frame (all
+        n rows landed), in completion order. Frames that completed before
+        registration are replayed first — registration and delivery
+        serialize on one lock, so no frame is missed or fired twice.
+        Exceptions are swallowed like done-callbacks. The fleet router rides
+        this to forward replica previews to its own ticket."""
+        with self._pcond:
+            self._preview_cbs.append(fn)
+            replay = list(self._phistory)
+        for step, frames in replay:
+            try:
+                fn(step, frames)
+            except Exception:  # noqa: BLE001 — observers must not poison
+                pass
+
+    def _preview(self, step: int, lo: int, hi: int,
+                 rows: np.ndarray) -> bool:
+        """Engine-side: land preview rows [lo, hi) of trajectory frame
+        ``step``. True when that frame just completed. Frames landing after
+        the ticket resolved, or for an already-completed step (a hedged
+        re-placement re-delivers the schedule), are dropped."""
+        step = int(step)
+        with self._lock:
+            if self._error is not None or self._event.is_set():
+                return False
+            if step in self._pdone:
+                return False
+            ent = self._pbuf.get(step)
+            if ent is None:
+                ent = self._pbuf[step] = [
+                    np.empty((self.n,) + rows.shape[1:], rows.dtype),
+                    self.n]
+            ent[0][lo:hi] = rows
+            ent[1] -= hi - lo
+            if ent[1] > 0:
+                return False
+            frames = self._pbuf.pop(step)[0]
+            self._pdone.add(step)
+        with self._pcond:
+            self._phistory.append((step, frames))
+            cbs = list(self._preview_cbs)
+            self._pcond.notify_all()
+        for fn in cbs:
+            try:
+                fn(step, frames)
+            except Exception:  # noqa: BLE001 — observers must not poison
+                pass
+        return True
+
+    def previews(self, timeout: Optional[float] = None):
+        """Iterate completed preview frames as ``(step, frames)`` — frames
+        is the (n, H, W, C) intermediate x̂0 prediction after scan step
+        ``step`` — blocking up to ``timeout`` between frames (TimeoutError
+        on expiry, with the engine health snapshot). The iterator ends when
+        the ticket RESOLVES and the history is drained: for a completed
+        request that is after the last preview; for a failed one it simply
+        stops early (the error surfaces via ``result()``/``exception()``).
+        A ticket without ``preview_every`` yields nothing and returns at
+        resolution."""
+        idx = 0
+        while True:
+            with self._pcond:
+                while len(self._phistory) <= idx and not self._event.is_set():
+                    if not self._pcond.wait(timeout):
+                        raise TimeoutError(self._timeout_msg(timeout))
+                if len(self._phistory) <= idx:
+                    return
+                step, frames = self._phistory[idx]
+                idx += 1
+            yield step, frames
 
     def _deliver(self, lo: int, hi: int, rows: np.ndarray) -> bool:
         """Engine-side: land request rows [lo, hi). True when complete.
@@ -201,6 +325,10 @@ class Request:
     n: int
     key: Optional[object] = None
     x_init: Optional[object] = None
+    #: extra per-row batch inputs some tasks ride along with x (host numpy,
+    #: leading dim n; the assembly thread slices rows like x_init). The
+    #: inpaint task carries {"known": (n,H,W,C), "mask": (n,H,W,1)}.
+    extras: Optional[dict] = None
     ticket: Ticket = field(default_factory=lambda: Ticket(0))
     #: engine-assigned id (submit order); fault tags and quarantine records
     #: name requests by it

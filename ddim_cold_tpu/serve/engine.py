@@ -85,8 +85,25 @@ from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
                                         RequestQuarantinedError)
 from ddim_cold_tpu.utils import faults
 from ddim_cold_tpu.utils.platform import watchdog_stall_s
+from ddim_cold_tpu.workloads import preview as workload_preview
+from ddim_cold_tpu.workloads import tasks as workload_tasks
 from ddim_cold_tpu.utils.profiling import latency_summary
 from ddim_cold_tpu.utils.watchdog import StallWatchdog
+
+#: per-task batch inputs that ride along with x through assembly — sliced
+#: per request row range, zero-padded, and placed exactly like the init
+#: batch (Request.extras carries the host arrays; order here is the
+#: program's positional argument order after x)
+_EXTRA_INPUTS = {"inpaint": ("known", "mask")}
+
+
+def _need_key(seed, rng) -> jax.Array:
+    if rng is None:
+        if seed is None:
+            raise ValueError("this request's init/noise draw is keyed — "
+                             "pass seed= or rng=")
+        rng = jax.random.PRNGKey(int(seed))
+    return rng
 
 
 class Engine:
@@ -173,6 +190,7 @@ class Engine:
         self.quarantined: list[int] = []  # rids bisection isolated
         self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
                       "padded_rows": 0, "max_queue_depth": 0,
+                      "preview_frames": 0,
                       "latencies_s": [], "param_bytes": None,
                       "param_bytes_quant": None,
                       # robustness counters (health snapshot)
@@ -185,6 +203,7 @@ class Engine:
     def submit(self, seed: Optional[int] = None, n: int = 1, *,
                rng: Optional[jax.Array] = None,
                x_init: Optional[np.ndarray] = None,
+               mask: Optional[np.ndarray] = None,
                config: Optional[SamplerConfig] = None,
                deadline_s: Optional[float] = None, **kwargs) -> Ticket:
         """Queue a sampling request; returns its :class:`Ticket`.
@@ -194,6 +213,16 @@ class Engine:
         pass ``x_init`` (an (n, H, W, C) or (H, W, C) encoded start; pair it
         with ``t_start`` — the ``sample_from`` path). Sampler options go in
         ``config`` or as keyword args (``k=, t_start=, cache_interval=, …``).
+
+        Editing workloads (``config.task`` in workloads.EDIT_TASKS) reuse
+        ``x_init`` as the task's image input: the known image (``inpaint``,
+        with ``mask=`` selecting the pixels to preserve), the upsampled
+        low-res start (``superres`` — see ``workloads.superres_init``), the
+        draft to forward-noise (``draft``), or the (2, H, W, C) endpoint pair
+        (``interp``, where ``n`` stays the path length). ``inpaint``,
+        ``draft`` and ``interp`` also need ``seed``/``rng`` — their noise
+        draw is keyed exactly like the direct workloads.* call, which is what
+        keeps the bitwise contract.
 
         ``deadline_s`` bounds the request's total time in the engine: past
         it, the request fails fast with :class:`DeadlineExceeded` instead of
@@ -205,24 +234,46 @@ class Engine:
             config = SamplerConfig(**kwargs)
         elif kwargs:
             raise ValueError(f"pass config OR keyword options, not both: {kwargs}")
-        if x_init is not None:
-            if config.sampler != "ddim":
-                raise ValueError("guided starts (x_init) are a DDIM path; "
-                                 "cold sampling has no encoded-start analogue")
-            x_init = np.asarray(x_init, np.float32)
-            if x_init.ndim == 3:
-                x_init = x_init[None]
-            if x_init.ndim != 4:
-                raise ValueError(f"x_init must be (n, H, W, C) or (H, W, C), "
-                                 f"got shape {x_init.shape}")
-            n = x_init.shape[0]
-            key = None
+        task = config.task
+        if mask is not None and task != "inpaint":
+            raise ValueError(
+                f"mask= is the inpaint task's input (config.task={task!r})")
+        extras = None
+        if task == "sample":
+            if x_init is not None:
+                if config.sampler != "ddim":
+                    raise ValueError(
+                        "guided starts (x_init) are a DDIM path; "
+                        "cold sampling has no encoded-start analogue")
+                x_init = self._as_batch(x_init)
+                n = x_init.shape[0]
+                key = None
+            else:
+                key = _need_key(seed, rng)
         else:
-            if rng is None:
-                if seed is None:
-                    raise ValueError("fresh requests need seed= or rng=")
-                rng = jax.random.PRNGKey(int(seed))
-            key = rng
+            if x_init is None:
+                raise ValueError(
+                    f"task {task!r} needs x_init= — its image input "
+                    "(inpaint: known image; superres: upsampled low-res; "
+                    "draft: the draft; interp: the (2, H, W, C) endpoints)")
+            x_init = self._as_batch(x_init)
+            if task == "interp":
+                # n stays the caller's path length; x_init is the pair
+                if x_init.shape[0] != 2:
+                    raise ValueError(
+                        "interp x_init is the endpoint PAIR (2, H, W, C) — "
+                        f"n= is the path length; got shape {x_init.shape}")
+            else:
+                n = x_init.shape[0]
+            key = None if task == "superres" else _need_key(seed, rng)
+            if task == "inpaint":
+                if mask is None:
+                    raise ValueError(
+                        "inpaint needs mask= (binary, 1 = known pixel — "
+                        "see workloads.normalize_mask)")
+                extras = {"known": np.ascontiguousarray(x_init),
+                          "mask": workload_tasks.normalize_mask(
+                              mask, int(n), self.model.img_size)}
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
         if deadline_s is not None and deadline_s < 0:
@@ -230,7 +281,7 @@ class Engine:
         deadline = (time.perf_counter() + deadline_s
                     if deadline_s is not None else None)
         req = Request(config=config, n=int(n), key=key, x_init=x_init,
-                      ticket=Ticket(n), deadline=deadline)
+                      ticket=Ticket(n), deadline=deadline, extras=extras)
         req.ticket._health_cb = self.health
         with self._lock:
             if self._closed:
@@ -249,6 +300,16 @@ class Engine:
             depth = len(self._pending)
         self.stats["max_queue_depth"] = max(self.stats["max_queue_depth"], depth)
         return req.ticket
+
+    @staticmethod
+    def _as_batch(x_init) -> np.ndarray:
+        x_init = np.asarray(x_init, np.float32)
+        if x_init.ndim == 3:
+            x_init = x_init[None]
+        if x_init.ndim != 4:
+            raise ValueError(f"x_init must be (n, H, W, C) or (H, W, C), "
+                             f"got shape {x_init.shape}")
+        return x_init
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -308,23 +369,49 @@ class Engine:
         s = jax.ShapeDtypeStruct(shape, self.model.dtype, sharding=sharding)
         return (s, s)
 
+    def _mask_struct(self, bucket: int):
+        H, W = self.model.img_size
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        return jax.ShapeDtypeStruct((bucket, H, W, 1), jnp.float32,
+                                    sharding=sharding)
+
     def _build_program(self, config: SamplerConfig, bucket: int):
         """AOT-compile the scan for this (config, bucket): trace with shape
         structs (no dummy allocation), compile, return the executable. The
-        executable is called with the NON-static args only (params, x, …)."""
+        executable is called with the NON-static args only (params, x, …).
+
+        ``preview_every > 0`` selects the sequence-returning variant of the
+        SAME scan — trajectory frames are the preview stream and the final
+        frame is the result (bitwise the last-only output), so previews cost
+        one program per (config, bucket) like everything else and zero extra
+        compiles at serve time. ``task`` picks the scan family: inpaint has
+        its own constrained scan; the other tasks reuse the plain programs
+        (their task-ness lives entirely in the init, so e.g. draft and
+        guided-sample configs with equal fields share an executable)."""
         x = self._x_struct(bucket)
         model, params = self._model_for(config), self._params_for(config)
+        seq = config.preview_every > 0
+        if config.task == "inpaint":
+            fn = (sampling._ddim_scan_inpaint_seq if seq
+                  else sampling._ddim_scan_inpaint)
+            return fn.lower(
+                model, params, x, x, self._mask_struct(bucket), self._key0,
+                k=config.k, t_start=config.t_start, eta=0.0,
+                sequence=seq).compile()
         if config.sampler == "cold":
             if config.cached:
                 return _cold_cached_lower(model, params, x,
-                                          self._cache_struct(bucket), config)
-            return sampling._cold_scan.lower(
+                                          self._cache_struct(bucket), config,
+                                          seq)
+            fn = sampling._cold_scan_seq if seq else sampling._cold_scan
+            return fn.lower(
                 model, params, x, levels=config.levels,
-                return_sequence=False).compile()
+                return_sequence=seq).compile()
         if config.cached:
             return _ddim_cached_lower(model, params, x, self._key0,
-                                      self._cache_struct(bucket), config)
-        return sampling._ddim_scan_last.lower(
+                                      self._cache_struct(bucket), config, seq)
+        fn = sampling._ddim_scan_sequence if seq else sampling._ddim_scan_last
+        return fn.lower(
             model, params, x, self._key0, k=config.k,
             t_start=config.t_start, eta=0.0).compile()
 
@@ -333,11 +420,28 @@ class Engine:
     def _request_init(self, req: Request) -> jax.Array:
         """The request's full init, drawn once at the request's own n —
         bitwise the direct sampler's draw (which depends on n); batches then
-        take row slices (which don't)."""
+        take row slices (which don't). Editing tasks route through the SAME
+        init builders the direct workloads.* functions use (one definition —
+        the bitwise contract is structural)."""
         if req._x_full is None:
             H, W = self.model.img_size
             C = self.model.in_chans
-            if req.x_init is not None:
+            task = req.config.task
+            if task == "draft":
+                req._x_full = workload_tasks.draft_init(
+                    req.key, jnp.asarray(req.x_init, jnp.float32),
+                    req.config.t_start, self.model.total_steps)
+            elif task == "interp":
+                pair = jnp.asarray(req.x_init, jnp.float32)
+                req._x_full = workload_tasks.interp_init(
+                    req.key, pair[0], pair[1], req.n, req.config.t_start,
+                    self.model.total_steps)
+            elif task == "inpaint":
+                # fresh noise start — x_init (the known image) rides along
+                # as a batch extra, it is not the scan's initial state
+                req._x_full = jax.random.normal(req.key, (req.n, H, W, C),
+                                                jnp.float32)
+            elif req.x_init is not None:
                 req._x_full = jnp.asarray(req.x_init, jnp.float32)
             elif req.config.sampler == "cold":
                 color = jax.random.normal(req.key, (req.n, 1, 1, C),
@@ -361,7 +465,11 @@ class Engine:
     def _assemble(self, plan: BatchPlan):
         """Background-thread H2D stage: build the padded bucket batch on
         device (init draws dispatch async; guided numpy starts upload here,
-        overlapping the main loop's compute)."""
+        overlapping the main loop's compute). Returns ``(plan, xs)`` with
+        ``xs`` a tuple: the init batch first, then any per-task extras
+        (``_EXTRA_INPUTS`` — inpaint's known/mask ride along, sliced and
+        padded exactly like x; zero-padding rows carry mask 0, so they pass
+        through the projection untouched)."""
         self._mark(f"assemble bucket={plan.bucket}")
         faults.fire("serve.assemble", tag=self._tag(plan))
         parts = [self._request_init(req)[lo:hi]
@@ -373,7 +481,18 @@ class Engine:
         x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
         if self.mesh is not None:
             x = jax.device_put(x, batch_sharding(self.mesh))
-        return plan, x
+        xs = [x]
+        for name in _EXTRA_INPUTS.get(plan.config.task, ()):
+            cols = [jnp.asarray(req.extras[name][lo:hi], jnp.float32)
+                    for req, lo, hi, _ in plan.entries]
+            if plan.padded_rows:
+                cols.append(jnp.zeros(
+                    (plan.padded_rows,) + cols[0].shape[1:], jnp.float32))
+            e = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=0)
+            if self.mesh is not None:
+                e = jax.device_put(e, batch_sharding(self.mesh))
+            xs.append(e)
+        return plan, tuple(xs)
 
     def _assemble_safe(self, plan: BatchPlan):
         """Assembly with the exception CAPTURED, not raised — the prefetch
@@ -381,8 +500,8 @@ class Engine:
         assembly fails (device_prefetch forwards a raise to the consumer and
         stops, which would strand every later batch)."""
         try:
-            plan, x = self._assemble(plan)
-            return plan, x, None
+            plan, xs = self._assemble(plan)
+            return plan, xs, None
         except Exception as exc:  # noqa: BLE001 — isolated per batch
             return plan, None, exc
 
@@ -397,12 +516,16 @@ class Engine:
             cache = step_cache.shard_cache(cache, self.mesh)
         return cache
 
-    def _dispatch(self, plan: BatchPlan, x: jax.Array):
+    def _dispatch(self, plan: BatchPlan, xs):
         prog = self.ensure_program(plan.config, plan.bucket)
         params = self._params_for(plan.config)
         self._mark(f"dispatch bucket={plan.bucket}")
         faults.fire("serve.dispatch", tag=self._tag(plan))
-        if plan.config.sampler == "cold":
+        if plan.config.task == "inpaint":
+            x, known, m = xs
+            out = prog(params, x, known, m, self._key0)
+        elif plan.config.sampler == "cold":
+            x, = xs
             if plan.config.cached:
                 out, cache_out = prog(params, x,
                                       self._take_cache(plan.bucket))
@@ -410,32 +533,36 @@ class Engine:
             else:
                 out = prog(params, x)
         elif plan.config.cached:
+            x, = xs
             out, cache_out = prog(params, x, self._key0,
                                   self._take_cache(plan.bucket))
             self._spare_caches[plan.bucket] = cache_out
         else:
+            x, = xs
             out = prog(params, x, self._key0)
         self.stats["dispatches"] += 1
         self.stats["rows"] += plan.rows
         self.stats["padded_rows"] += plan.padded_rows
         return out
 
-    def _dispatch_retry(self, plan: BatchPlan, x: jax.Array):
+    def _dispatch_retry(self, plan: BatchPlan, xs):
         """Dispatch with capped exponential backoff on the retryable fault
         class. The donated input is rebuilt per attempt when the failed call
-        already consumed it (donation deletes the buffer even on error)."""
+        already consumed it (donation deletes the buffer even on error; only
+        ``xs[0]`` — the scan state — is ever donated, the conditioning extras
+        are not)."""
         delay = self.retry_base_s
         for attempt in range(self.max_retries + 1):
             try:
-                return self._dispatch(plan, x)
+                return self._dispatch(plan, xs)
             except RETRYABLE_EXCEPTIONS:
                 if attempt == self.max_retries:
                     raise
                 self.stats["retries"] += 1
                 time.sleep(min(delay, self.retry_cap_s))
                 delay = min(delay * 2, self.retry_cap_s)
-                if getattr(x, "is_deleted", lambda: False)():
-                    _, x, err = self._assemble_safe(plan)
+                if getattr(xs[0], "is_deleted", lambda: False)():
+                    _, xs, err = self._assemble_safe(plan)
                     if err is not None:
                         raise err
         raise AssertionError("unreachable: loop returns or raises")
@@ -450,7 +577,7 @@ class Engine:
         return BatchPlan(config=plan.config, bucket=plan.bucket,
                          entries=tuple(packed), rows=offset)
 
-    def _dispatch_safe(self, plan: BatchPlan, x) -> list:
+    def _dispatch_safe(self, plan: BatchPlan, xs) -> list:
         """Dispatch with full failure isolation; returns the list of
         (plan, out) that actually went to the device.
 
@@ -475,7 +602,7 @@ class Engine:
             self.stats["skipped_batches"] += 1
             return []
         try:
-            return [(plan, self._dispatch_retry(plan, x))]
+            return [(plan, self._dispatch_retry(plan, xs))]
         except Exception as exc:  # noqa: BLE001 — isolate, bisect, quarantine
             self.stats["failed_batches"] += 1
             reqs = list({id(r): r for r, *_ in plan.entries}.values())
@@ -510,7 +637,12 @@ class Engine:
     def _finish(self, plan: BatchPlan, out) -> None:
         """D2H + delivery: one blocking fetch per batch, rows copied into
         each ticket's buffer; padding rows are simply never read. A fetch
-        failure fails only this batch's tickets."""
+        failure fails only this batch's tickets.
+
+        Preview-enabled configs fetch the whole trajectory: the scheduled
+        intermediate x̂0 frames stream to each ticket's preview buffer
+        (``Ticket.previews()``) before the FINAL frame — bitwise the
+        last-only program's output — is delivered as the result."""
         try:
             self._mark(f"fetch bucket={plan.bucket}")
             host = np.asarray(out)
@@ -519,6 +651,21 @@ class Engine:
         except Exception as exc:  # noqa: BLE001 — isolated per batch
             self._fail_plan(plan, exc, "fetch")
             return
+        every = plan.config.preview_every
+        if every:
+            try:
+                faults.fire("serve.preview", tag=self._tag(plan))
+                steps = host.shape[0] - 1  # frame 0 is the init
+                for j in workload_preview.preview_indices(steps, every):
+                    frame = host[j]
+                    for req, lo, hi, offset in plan.entries:
+                        if req.ticket._preview(
+                                j, lo, hi, frame[offset:offset + (hi - lo)]):
+                            self.stats["preview_frames"] += 1
+            except Exception as exc:  # noqa: BLE001 — isolated per batch
+                self._fail_plan(plan, exc, "preview")
+                return
+            host = host[-1]
         for req, lo, hi, offset in plan.entries:
             if req.ticket._deliver(lo, hi, host[offset:offset + (hi - lo)]):
                 self.stats["latencies_s"].append(req.ticket.latency_s)
@@ -670,7 +817,7 @@ class Engine:
                 self._mark(f"plan {len(live)} requests")
                 plans = plan_batches(live, self.buckets)
                 inflight: deque = deque()
-                for plan, x, err in device_prefetch(
+                for plan, xs, err in device_prefetch(
                         plans, self._assemble_safe,
                         depth=self.prefetch_depth):
                     if self._stalled:
@@ -678,7 +825,7 @@ class Engine:
                     if err is not None:
                         self._fail_plan(plan, err, "assembly")
                         continue
-                    for item in self._dispatch_safe(plan, x):
+                    for item in self._dispatch_safe(plan, xs):
                         inflight.append(item)
                         batches += 1
                         rows += item[0].rows
@@ -725,15 +872,21 @@ class Engine:
         return live
 
 
-def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig):
-    return sampling._ddim_scan_cached.lower(
+def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig,
+                       seq: bool = False):
+    fn = (sampling._ddim_scan_cached_seq if seq
+          else sampling._ddim_scan_cached)
+    return fn.lower(
         model, params, x, key, cache, k=config.k, t_start=config.t_start,
         eta=0.0, cache_interval=config.cache_interval,
-        cache_mode=config.cache_mode, sequence=False).compile()
+        cache_mode=config.cache_mode, sequence=seq).compile()
 
 
-def _cold_cached_lower(model, params, x, cache, config: SamplerConfig):
-    return sampling._cold_scan_cached.lower(
-        model, params, x, cache, levels=config.levels, return_sequence=False,
+def _cold_cached_lower(model, params, x, cache, config: SamplerConfig,
+                       seq: bool = False):
+    fn = (sampling._cold_scan_cached_seq if seq
+          else sampling._cold_scan_cached)
+    return fn.lower(
+        model, params, x, cache, levels=config.levels, return_sequence=seq,
         cache_interval=config.cache_interval,
         cache_mode=config.cache_mode).compile()

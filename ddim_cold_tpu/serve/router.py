@@ -42,8 +42,10 @@ forever — every path ends in delivery or a typed failure naming the
 replica it happened on.
 
 This module is host-only (graftcheck A004): routing must never touch a
-device array — requests carry opaque rng/x_init payloads straight through
-to the replica's ``submit``.
+device array — requests carry opaque rng/x_init/mask payloads straight
+through to the replica's ``submit`` (editing workloads route like plain
+sampling; preview frames come back through the replica ticket's
+preview-callback hook, host numpy end to end).
 """
 
 from __future__ import annotations
@@ -248,13 +250,20 @@ class Router:
         return max(1, (self.max_pending * w) // total_w)
 
     def submit(self, seed: Optional[int] = None, n: int = 1, *,
-               rng=None, x_init=None,
+               rng=None, x_init=None, mask=None,
                config: Optional[SamplerConfig] = None,
                tenant: str = "default", priority: int = 0,
                deadline_s: Optional[float] = None, **kwargs) -> Ticket:
         """Queue a request with the fleet; returns a :class:`Ticket` with
         the engine ticket's exact surface (``result``/``exception``/
         ``done``; timeout messages embed the ROUTER health snapshot).
+
+        Editing workloads submit exactly like at the engine: ``config.task``
+        picks the task, ``x_init`` carries its image input, ``mask=`` the
+        inpaint pixel selector (see ``Engine.submit``). With
+        ``config.preview_every`` set, the replica's completed preview frames
+        are forwarded to THIS ticket's ``previews()`` stream — a hedged
+        re-placement re-delivers its schedule, deduped per step.
 
         ``tenant`` scopes fair-share admission; higher ``priority`` places
         first within a tenant. Raises :class:`QueueFullError` when the
@@ -266,11 +275,26 @@ class Router:
         elif kwargs:
             raise ValueError(
                 f"pass config OR keyword options, not both: {kwargs}")
+        task = config.task
+        if mask is not None and task != "inpaint":
+            raise ValueError(
+                f"mask= is the inpaint task's input (config.task={task!r})")
+        if task != "sample" and x_init is None:
+            raise ValueError(f"task {task!r} needs x_init= — its image "
+                             "input (see Engine.submit)")
+        if task == "inpaint" and mask is None:
+            raise ValueError("inpaint needs mask= (binary, 1 = known pixel)")
         if x_init is not None:
             x_init = np.asarray(x_init, np.float32)
-            n = x_init.shape[0] if x_init.ndim == 4 else 1
-        elif seed is None and rng is None:
-            raise ValueError("fresh requests need seed= or rng=")
+            if task != "interp":
+                # interp keeps the caller's n (the path length); everything
+                # else takes its row count from the batch input
+                n = x_init.shape[0] if x_init.ndim == 4 else 1
+        needs_key = (task in ("inpaint", "draft", "interp")
+                     or (task == "sample" and x_init is None))
+        if needs_key and seed is None and rng is None:
+            raise ValueError("this request's init/noise draw is keyed — "
+                             "pass seed= or rng=")
         n = int(n)
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
@@ -279,7 +303,7 @@ class Router:
         deadline = (time.perf_counter() + deadline_s
                     if deadline_s is not None else None)
         call = {"seed": seed, "n": n, "rng": rng, "x_init": x_init,
-                "config": config}
+                "mask": mask, "config": config}
         with self._lock:
             if self._closed:
                 raise EngineClosedError(
@@ -385,6 +409,12 @@ class Router:
             freq.tried.add(rid)
             freq.placed_on = rid
             self.stats["placements"] += 1
+            if freq.call["config"].preview_every:
+                # forward completed replica frames to the router ticket;
+                # its per-step dedupe absorbs a hedge's re-delivery
+                t.add_preview_callback(
+                    lambda step, frames, f=freq:
+                        f.ticket._preview(step, 0, f.n, frames))
             t.add_done_callback(
                 lambda t_, f=freq, r=rid: self._on_ticket(f, r, t_))
             return True

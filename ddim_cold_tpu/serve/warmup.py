@@ -31,9 +31,13 @@ def warmup(engine, configs: Sequence[SamplerConfig],
 
     ``configs`` is the exact set of :class:`SamplerConfig` the deployment
     serves (an unlisted config would compile lazily at serve time — counted,
-    and caught by the guard test). Returns a report with the number of new
-    compiles, total resident programs, and the persistent-cache directory
-    (None when disabled or the running JAX lacks the feature).
+    and caught by the guard test). Editing workloads are ordinary configs
+    here — ``workloads.default_edit_configs()`` is the ready-made set
+    covering every task (preview-enabled variants are distinct programs:
+    warm them with the ``preview_every`` you serve). Returns a report with
+    the number of new compiles, total resident programs, and the
+    persistent-cache directory (None when disabled or the running JAX lacks
+    the feature).
 
     ``tolerate_errors=True`` keeps warming the remaining programs when one
     compile fails (degraded startup beats no startup: a config whose compile

@@ -49,6 +49,7 @@ ENV_VAR = "DDIM_COLD_FAULTS"
 #: the named fault sites (typo guard for specs; ``fire`` itself accepts any
 #: string so a site can be added where it is fired before it is listed here)
 SITES = ("serve.assemble", "serve.dispatch", "serve.fetch", "serve.compile",
+         "serve.preview",
          "ckpt.save", "data.next",
          "router.place", "router.failover", "replica.spawn")
 KINDS = ("transient", "permanent", "latency", "corrupt")

@@ -1,0 +1,47 @@
+"""Guided-editing workloads: inpainting, super-resolution, draft→drawing and
+slerp interpolation as first-class products over the serving stack.
+
+Each task is a pure (init-state, schedule-suffix, per-step constraint)
+triple over the samplers in ops/sampling.py — usable directly (one function
+call) or served (a ``SamplerConfig(task=...)`` through ``Engine``/``Router``
+with the bitwise-vs-direct and zero-compiles-after-warmup contracts intact).
+``preview.py`` pins the streaming-preview frame schedule
+(``SamplerConfig(preview_every=m)`` + ``Ticket.previews()``).
+
+Quickstart (direct)::
+
+    from ddim_cold_tpu import workloads
+    out  = workloads.inpaint(model, params, rng, known, mask, k=10)
+    hi   = workloads.super_resolve(model, params, low_res, level=4)
+    img  = workloads.draft_to_drawing(model, params, rng, draft, t_start=1800)
+    path = workloads.interpolate(model, params, rng, img_a, img_b, n_interp=8)
+
+Quickstart (served, with streaming previews)::
+
+    from ddim_cold_tpu import serve, workloads
+    eng = serve.Engine(model, params, buckets=(8, 32))
+    serve.warmup(eng, workloads.default_edit_configs(preview_every=2))
+    cfg = serve.SamplerConfig(task="draft", t_start=1800, preview_every=2)
+    t = eng.submit(seed=0, x_init=draft, config=cfg)
+    eng.run()
+    for step, frames in t.previews():   # intermediate x̂0 frames, in order
+        show(step, frames)
+    final = t.result()
+
+This package never imports ``serve`` at module level — serve/engine.py
+imports it for the shared init builders.
+"""
+
+from ddim_cold_tpu.workloads.preview import preview_indices
+from ddim_cold_tpu.workloads.tasks import (EDIT_TASKS, TASKS,
+                                           default_edit_configs, draft_init,
+                                           draft_to_drawing, inpaint,
+                                           interp_init, interpolate,
+                                           normalize_mask, super_resolve,
+                                           superres_init)
+
+__all__ = [
+    "EDIT_TASKS", "TASKS", "default_edit_configs", "draft_init",
+    "draft_to_drawing", "inpaint", "interp_init", "interpolate",
+    "normalize_mask", "preview_indices", "super_resolve", "superres_init",
+]

@@ -1,0 +1,200 @@
+"""The guided-editing tasks: each one a pure (init-state, schedule-suffix,
+per-step constraint) triple over the existing samplers.
+
+Every task in :data:`EDIT_TASKS` is two things at once:
+
+* a **direct function** here (``inpaint``, ``super_resolve``,
+  ``draft_to_drawing``, ``interpolate``) — the offline, single-call form,
+  composing ops/sampling.py exactly the way the reference apps do
+  (ViT_draft2drawing.py); and
+* a **served product**: a :class:`~ddim_cold_tpu.serve.batching.SamplerConfig`
+  with ``task=<name>`` submitted through ``Engine``/``Router``, which
+  coalesces into the same buckets, warmup, step-cache, quant and fleet
+  machinery as plain sampling — bitwise-equal to the direct call for the
+  same rng (the engine contract, inherited because every init builder here
+  is per-row and drawn at the request's own n).
+
+The init builders (:func:`draft_init`, :func:`interp_init`,
+:func:`superres_init`) are the SINGLE definition both paths use — the direct
+functions and serve/engine.py's ``_request_init`` call the same code, so the
+bitwise contract is structural, not coincidental.
+
+| task       | sampler | init state                      | per-step constraint |
+|------------|---------|---------------------------------|---------------------|
+| inpaint    | ddim    | fresh noise from the request key| x̂0 mask re-projection
+| superres   | cold    | nearest-upsampled low-res input | none (cold scan)    |
+| draft      | ddim    | ``forward_noise(draft, t_start)``| none (suffix scan)  |
+| interp     | ddim    | slerp of two encoded endpoints  | none (suffix scan)  |
+
+This module imports ops/data layers only — never ``serve`` at module level
+(serve/engine.py imports it; the one serve-touching helper,
+:func:`default_edit_configs`, imports lazily).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.ops import degrade, sampling
+
+#: the served editing tasks; "sample" (plain generation) completes the
+#: SamplerConfig ``task`` domain (serve/batching.py keeps its own literal
+#: copy — host-only module — pinned equal by tests/test_workloads.py)
+EDIT_TASKS = ("inpaint", "superres", "draft", "interp")
+TASKS = ("sample",) + EDIT_TASKS
+
+
+# ---------------------------------------------------------------- inputs
+
+def normalize_mask(mask, n: int, img_size) -> np.ndarray:
+    """User mask → the engine/scan contract: float32 (n, H, W, 1) of {0, 1}
+    (1 = KNOWN pixel, preserved exactly; 0 = to be synthesized).
+
+    Accepts (H, W), (H, W, 1), (n, H, W) or (n, H, W, 1); a single mask
+    broadcasts over the batch. Values must be exactly binary — the
+    idempotence contract ("known pixels bit-preserved") is only meaningful
+    for a hard projection, so soft masks are rejected rather than silently
+    thresholded. Host-side numpy on purpose: the engine slices request rows
+    out of this array on its assembly thread.
+    """
+    H, W = img_size
+    m = np.asarray(mask, np.float32)
+    if m.ndim == 2:
+        m = m[None, :, :, None]
+    elif m.ndim == 3:
+        m = m[None] if m.shape == (H, W, 1) else m[..., None]
+    if m.ndim != 4 or m.shape[1:] != (H, W, 1):
+        raise ValueError(
+            f"mask must be (H, W), (H, W, 1), (n, H, W) or (n, H, W, 1) "
+            f"for image size {(H, W)}, got shape {np.shape(mask)}")
+    if m.shape[0] == 1 and n > 1:
+        m = np.broadcast_to(m, (n, H, W, 1))
+    if m.shape[0] != n:
+        raise ValueError(f"mask batch {m.shape[0]} != request n {n}")
+    if not np.isin(m, (0.0, 1.0)).all():
+        raise ValueError(
+            "mask must be binary {0, 1} — known pixels are re-projected "
+            "EXACTLY, which a soft mask cannot mean")
+    return np.ascontiguousarray(m)
+
+
+# ----------------------------------------------------------- init builders
+
+def draft_init(rng: jax.Array, draft: jax.Array, t_start: int,
+               total_steps: int = 2000) -> jax.Array:
+    """Draft→drawing init: the sketch forward-noised to ``t_start``
+    (reference ViT_draft2drawing.py:395) — then the task is just
+    ``sample_from``. Per-row (the noise draw shape is the draft's own
+    (n, H, W, C)), so the engine draws it at the request's n and slices."""
+    return sampling.forward_noise(rng, draft, t_start, total_steps)
+
+
+#: interp init: the slerp-mixed encodings of the endpoint pair — the exact
+#: states ``slerp_interpolate`` decodes (one definition, ops/sampling.py)
+interp_init = sampling.interp_states
+
+
+def superres_init(low_res, size: int) -> np.ndarray:
+    """Super-resolution init: the low-res input nearest-upsampled to the
+    model's size — i.e. the cold-degraded full-size state D(x, level) for
+    the unknown original (ops/degrade.upsample_nearest). Returned as host
+    numpy: it is a guided-start payload for ``Engine.submit(x_init=...)``."""
+    return np.asarray(degrade.upsample_nearest(low_res, size))
+
+
+# --------------------------------------------------------- direct functions
+
+def inpaint(model, params, rng: jax.Array, known, mask, *, k: int = 10,
+            t_start: Optional[int] = None, eta: float = 0.0,
+            return_sequence: bool = False) -> jax.Array:
+    """Training-free inpainting: DDIM from fresh noise with per-step mask
+    re-projection of the known pixels (ops/sampling._ddim_inpaint_impl).
+    ``known`` is the reference image in [−1, 1]; ``mask`` selects the pixels
+    to preserve (see :func:`normalize_mask`). Known pixels of the result are
+    ``(known + 1) / 2`` bit-exactly. Served form:
+    ``SamplerConfig(task="inpaint")`` + ``submit(seed=, x_init=known,
+    mask=)``."""
+    known = jnp.asarray(known, jnp.float32)
+    if known.ndim == 3:
+        known = known[None]
+    n = known.shape[0]
+    m = jnp.asarray(normalize_mask(mask, n, model.img_size))
+    H, W = model.img_size
+    x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    # same fold as ddim_sample: the (eta>0-only) per-step noise key must not
+    # correlate with the init draw; eta=0 (the served path) never reads it
+    noise_rng = jax.random.fold_in(rng, 0xD1F)
+    fn = (sampling._ddim_scan_inpaint_seq if return_sequence
+          else sampling._ddim_scan_inpaint)
+    return fn(model, params, x_init, known, m, noise_rng, k=k,
+              t_start=t_start, eta=eta, sequence=return_sequence)
+
+
+def super_resolve(model, params, low_res, *, level: int,
+                  cache_interval: int = 1, cache_mode: str = "delta",
+                  return_sequence: bool = False, mesh=None) -> jax.Array:
+    """Training-free super-resolution: treat the low-res input as the cold
+    degradation at ``level`` (it IS one — nearest-downsampling is the cold
+    operator), upsample it into the sampler's state space, and run the cold
+    scan from that level down. With a 1×1 constant-color input and the full
+    level count this is exactly ``cold_sample`` (equivalence pinned in
+    tests/test_workloads.py). Served form: ``SamplerConfig(sampler="cold",
+    task="superres", levels=level)`` + ``submit(x_init=superres_init(...))``.
+    """
+    x_init = degrade.upsample_nearest(low_res, model.img_size[0])
+    return sampling.cold_sample(model, params, x_init=x_init,
+                                levels=int(level),
+                                return_sequence=return_sequence, mesh=mesh,
+                                cache_interval=cache_interval,
+                                cache_mode=cache_mode)
+
+
+def draft_to_drawing(model, params, rng: jax.Array, draft, *,
+                     t_start: int = 1800, k: int = 10,
+                     cache_interval: int = 1, cache_mode: str = "delta",
+                     return_sequence: bool = False, mesh=None) -> jax.Array:
+    """The reference's headline app (ViT_draft2drawing.py:394-408):
+    forward-noise a rough draft to an intermediate ``t_start``, then DDIM
+    back down — the sampler keeps the draft's layout and invents the detail.
+    Served form: ``SamplerConfig(task="draft", t_start=)`` +
+    ``submit(seed=, x_init=draft)``."""
+    draft = jnp.asarray(draft, jnp.float32)
+    if draft.ndim == 3:
+        draft = draft[None]
+    encoded = draft_init(rng, draft, t_start, model.total_steps)
+    return sampling.sample_from(model, params, encoded, t_start, k=k,
+                                return_sequence=return_sequence, mesh=mesh,
+                                cache_interval=cache_interval,
+                                cache_mode=cache_mode)
+
+
+#: slerp interpolation promoted to a first-class task: the direct form is
+#: ops/sampling.slerp_interpolate itself; the served form is
+#: ``SamplerConfig(task="interp", t_start=)`` + ``submit(seed=,
+#: x_init=np.stack([img_a, img_b]), n=n_interp)``.
+interpolate = sampling.slerp_interpolate
+
+
+# ------------------------------------------------------------ serve configs
+
+def default_edit_configs(*, k: int = 10, t_start: int = 1800,
+                         sr_level: int = 4, preview_every: int = 0) -> list:
+    """One ready-to-warm SamplerConfig per editing task — the set a serving
+    deployment passes to ``serve.warmup`` / ``Router(configs=...)`` to get
+    every workload compile-free. Lazy serve import: this module stays below
+    the serve layer."""
+    from ddim_cold_tpu.serve.batching import SamplerConfig
+
+    return [
+        SamplerConfig(task="inpaint", k=k, preview_every=preview_every),
+        SamplerConfig(task="superres", sampler="cold", levels=sr_level,
+                      preview_every=preview_every),
+        SamplerConfig(task="draft", k=k, t_start=t_start,
+                      preview_every=preview_every),
+        SamplerConfig(task="interp", k=k, t_start=t_start,
+                      preview_every=preview_every),
+    ]

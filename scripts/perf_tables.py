@@ -151,6 +151,25 @@ def render(path: str) -> str:
             f"{ft.get('replicas_spawned')} · compiles after warmup "
             f"{ft.get('compiles_after_warmup')}")
 
+    ed = sub.get("edit")
+    if ed:
+        per = ed.get("per_task", {})
+        pv = ed.get("preview", {})
+        lines.append("")
+        lines.append(
+            "**editing workloads (img/s):** "
+            + " · ".join(f"{task}={leg.get('img_per_sec')}"
+                         for task, leg in per.items())
+            + f" · k={ed.get('k')} · compiles after warmup "
+              f"{ed.get('compiles_after_warmup')}")
+        if pv:
+            lines.append(
+                f"streamed previews (every={pv.get('every')}): first frame "
+                f"{pv.get('latency_to_first_frame_s')}s of "
+                f"{pv.get('total_s')}s drain "
+                f"({pv.get('first_frame_fraction')}× wall) · "
+                f"{pv.get('frames')} frames")
+
     for key, label in (("cached_quality_64px", "cached quality 64px"),
                        ("quant_quality_64px", "w8a16 quality 64px"),
                        ("quant_cached_quality_64px",

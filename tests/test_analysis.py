@@ -366,3 +366,20 @@ def test_fleet_layer_is_covered_by_a003_and_a004():
         assert mod in ast_checks.HOST_ONLY_MODULES, mod
     for site in ("router.place", "router.failover", "replica.spawn"):
         assert site in faults.SITES, site
+
+
+def test_workload_sites_and_sweep_registered():
+    """The editing workloads stay inside the static net: the preview
+    delivery stage is a registered fault site (A003) and every task — plus
+    the preview-enabled scan variants — appears in the J006 serve sweep, so
+    the zero-compiles contract is proven for them too."""
+    from ddim_cold_tpu.analysis import entries
+    from ddim_cold_tpu.utils import faults
+
+    assert "serve.preview" in faults.SITES
+    labels = [label for label, _, _ in entries.serve_sweep()]
+    for label in ("inpaint_k500", "inpaint_k500_pv2", "inpaint_k500_qxla",
+                  "superres_l3", "superres_l3_ci2", "superres_l3_pv1",
+                  "draft_k500_t1200", "draft_k500_t1200_ci2",
+                  "interp_k500_t400", "ddim_k500_pv2", "ddim_k500_ci2_pv2"):
+        assert label in labels, label
