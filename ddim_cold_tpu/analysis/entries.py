@@ -44,6 +44,30 @@ def serve_sweep():
     return [
         ("ddim_k500", SamplerConfig(k=K), (4, 8)),
         ("ddim_k500_ci2", SamplerConfig(k=K, cache_interval=2), (4, 8)),
+        # adaptive/token caching (ISSUE 8). ONE adaptive threshold value in
+        # the whole sweep: signature_hash is constant-blind, so a second
+        # threshold would collide by design. Distinct token_k values ARE
+        # structurally distinct (the gathered (B, k, E) aval differs).
+        ("ddim_k500_adapt",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
+                       cache_threshold=0.05), (4, 8)),
+        ("ddim_k500_adapt_qxla",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
+                       cache_threshold=0.05, quant="xla"), (4,)),
+        ("ddim_k500_tok3",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="token",
+                       cache_tokens=3), (4, 8)),
+        ("ddim_k500_tok2",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="token",
+                       cache_tokens=2), (4,)),
+        ("cold_l4_adapt",
+         SamplerConfig(sampler="cold", levels=4, cache_interval=2,
+                       cache_mode="adaptive", cache_threshold=0.05), (4,)),
+        ("inpaint_k500_ci2",
+         SamplerConfig(task="inpaint", k=K, cache_interval=2), (4, 8)),
+        ("inpaint_k500_tok3",
+         SamplerConfig(task="inpaint", k=K, cache_interval=2,
+                       cache_mode="token", cache_tokens=3), (4,)),
         ("cold_l4", SamplerConfig(sampler="cold", levels=4), (4, 8)),
         ("ddim_k500_t999", SamplerConfig(k=K, t_start=999), (4, 8)),
         ("ddim_k500_qxla", SamplerConfig(k=K, quant="xla"), (4,)),
@@ -127,13 +151,16 @@ class Context:
         return jax.ShapeDtypeStruct((n, H, W, self.model.in_chans),
                                     jnp.float32)
 
-    def cache(self, n: int):
+    def cache(self, n: int, mode: str = "delta"):
         from ddim_cold_tpu.ops import step_cache
 
+        H, W = self.model.img_size
         return jax.eval_shape(
             lambda: step_cache.init_cache(n, self.model.num_patches + 1,
                                           self.model.embed_dim,
-                                          self.model.dtype))
+                                          self.model.dtype, mode=mode,
+                                          img_shape=(H, W,
+                                                     self.model.in_chans)))
 
     def mask(self, n: int):
         H, W = self.model.img_size
@@ -157,6 +184,19 @@ def build_entries(ctx: Context) -> list[Entry]:
               (p, x, key), (m,), dict(ddim_kw)),
         Entry("ddim_scan_cached", SAMP, sampling._ddim_scan_cached,
               (p, x, key, ctx.cache(N)), (m,),
+              dict(ddim_kw, cache_interval=2, cache_mode="delta",
+                   sequence=False), donates=True),
+        Entry("ddim_scan_cached_adaptive", SAMP, sampling._ddim_scan_cached,
+              (p, x, key, ctx.cache(N, "adaptive")), (m,),
+              dict(ddim_kw, cache_interval=2, cache_mode="adaptive",
+                   cache_threshold=0.05, sequence=False), donates=True),
+        Entry("ddim_scan_cached_token", SAMP, sampling._ddim_scan_cached,
+              (p, x, key, ctx.cache(N, "token")), (m,),
+              dict(ddim_kw, cache_interval=2, cache_mode="token",
+                   cache_tokens=3, sequence=False), donates=True),
+        Entry("ddim_scan_inpaint_cached", SAMP,
+              sampling._ddim_scan_inpaint_cached,
+              (p, x, x, ctx.mask(N), key, ctx.cache(N)), (m,),
               dict(ddim_kw, cache_interval=2, cache_mode="delta",
                    sequence=False), donates=True),
         Entry("cold_scan", SAMP, sampling._cold_scan, (p, x), (m,),
@@ -232,9 +272,21 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
     params = ctx.qparams if config.quant else ctx.params
     x = ctx.x(bucket)
     seq = config.preview_every > 0
+    cache_kw = dict(cache_interval=config.cache_interval,
+                    cache_mode=config.cache_mode,
+                    cache_threshold=config.cache_threshold,
+                    cache_tokens=config.cache_tokens or None)
     if config.task == "inpaint":
         H, W = ctx.model.img_size
         mask = jax.ShapeDtypeStruct((bucket, H, W, 1), jnp.float32)
+        if config.cached:
+            fn = (sampling._ddim_scan_inpaint_cached_seq if seq
+                  else sampling._ddim_scan_inpaint_cached)
+            return Entry("serve", "", fn,
+                         (params, x, ctx.x(bucket), mask, ctx.key,
+                          ctx.cache(bucket, config.cache_mode)), (model,),
+                         dict(k=config.k, t_start=config.t_start, eta=0.0,
+                              sequence=seq, **cache_kw))
         fn = (sampling._ddim_scan_inpaint_seq if seq
               else sampling._ddim_scan_inpaint)
         return Entry("serve", "", fn,
@@ -246,10 +298,10 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
             fn = (sampling._cold_scan_cached_seq if seq
                   else sampling._cold_scan_cached)
             return Entry("serve", "", fn,
-                         (params, x, ctx.cache(bucket)), (model,),
+                         (params, x, ctx.cache(bucket, config.cache_mode)),
+                         (model,),
                          dict(levels=config.levels, return_sequence=seq,
-                              cache_interval=config.cache_interval,
-                              cache_mode=config.cache_mode))
+                              **cache_kw))
         fn = sampling._cold_scan_seq if seq else sampling._cold_scan
         return Entry("serve", "", fn, (params, x), (model,),
                      dict(levels=config.levels, return_sequence=seq))
@@ -257,10 +309,10 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
         fn = (sampling._ddim_scan_cached_seq if seq
               else sampling._ddim_scan_cached)
         return Entry("serve", "", fn,
-                     (params, x, ctx.key, ctx.cache(bucket)), (model,),
+                     (params, x, ctx.key,
+                      ctx.cache(bucket, config.cache_mode)), (model,),
                      dict(k=config.k, t_start=config.t_start, eta=0.0,
-                          cache_interval=config.cache_interval,
-                          cache_mode=config.cache_mode, sequence=seq))
+                          sequence=seq, **cache_kw))
     fn = (sampling._ddim_scan_sequence if seq
           else sampling._ddim_scan_last)
     return Entry("serve", "", fn,
@@ -268,14 +320,22 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
                  dict(k=config.k, t_start=config.t_start, eta=0.0))
 
 
-def serve_signatures(ctx: Context) -> dict[str, str]:
-    """``"<label>:b<bucket>" → trace hash`` for the whole warmed sweep."""
+def serve_signatures(ctx: Context,
+                     findings: list | None = None) -> dict[str, str]:
+    """``"<label>:b<bucket>" → trace hash`` for the whole warmed sweep.
+    When ``findings`` is passed, each trace is also run through the J007
+    static-trip-count check (no extra tracing — the J006 trace is reused)."""
     out = {}
     for label, config, buckets in serve_sweep():
         for bucket in buckets:
             e = _serve_entry(ctx, config, bucket)
+            closed = e.trace()
             out[f"{label}:b{bucket}"] = jaxpr_checks.signature_hash(
-                e.trace(), e.dyn_args)
+                closed, e.dyn_args)
+            if findings is not None:
+                findings += jaxpr_checks.check_static_trip_count(
+                    closed, f"{label}:b{bucket}",
+                    "ddim_cold_tpu/serve/engine.py")
     return out
 
 
@@ -290,11 +350,16 @@ def run_serve_signature_check() -> list[Finding]:
     (serve/router.py): a replacement replica warms from the same
     (config, bucket) set in a freshly built world, which is exactly the
     world-B trace here — hash-equal programs mean the replacement serves
-    from its own warmup without a single in-service compile."""
+    from its own warmup without a single in-service compile.
+
+    The world-A traces are also run through J007 (static trip count): no
+    served program — in particular no adaptive-gated cached sampler — may
+    contain a ``while`` primitive, so the drift gate provably cannot vary
+    the loop structure at run time."""
     PATH = "ddim_cold_tpu/serve/engine.py"
-    sigs_a = serve_signatures(Context())
+    findings: list[Finding] = []
+    sigs_a = serve_signatures(Context(), findings)
     sigs_b = serve_signatures(Context())
-    findings = []
     by_hash: dict[str, str] = {}
     for subject, h in sigs_a.items():
         if sigs_b[subject] != h:

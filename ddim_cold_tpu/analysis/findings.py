@@ -38,6 +38,10 @@ RULES = {
     "GRAFT-J006": "unstable or colliding abstract trace signature across the "
                   "serve sweep — breaks the zero-compiles-after-warmup "
                   "guarantee",
+    "GRAFT-J007": "`while` primitive in a served sampler program — a "
+                  "data-dependent trip count; the adaptive drift gate must "
+                  "select branches INSIDE one static-trip scan, never "
+                  "vary the loop itself",
     "GRAFT-A001": "wall-clock/stdlib-random call inside a jitted or scanned "
                   "function — nondeterminism the fault-replay contract "
                   "(utils/faults.py) forbids",

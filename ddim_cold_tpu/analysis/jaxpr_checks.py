@@ -211,6 +211,32 @@ def check_host_callbacks(closed_jaxpr, entry: str, path: str) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# J007 — data-dependent trip counts in served programs
+# ---------------------------------------------------------------------------
+
+def check_static_trip_count(closed_jaxpr, entry: str,
+                            path: str) -> list[Finding]:
+    """A ``while`` primitive's trip count is decided by device data at run
+    time — the one loop form that can differ between two executions of the
+    same compiled program. Served sampler programs must be pure static-trip
+    ``scan``: the adaptive drift gate picks a *branch index* inside the scan
+    body (``lax.switch`` over a static branch set), so a gate-induced
+    ``while`` here means the caching rewrite broke the
+    one-program-per-(config, bucket) contract."""
+    out, count = [], 0
+    for eqn, _ in iter_eqns(closed_jaxpr):
+        if eqn.primitive.name == "while":
+            count += 1
+    if count:
+        out.append(Finding(
+            "GRAFT-J007", path, f"{entry}:while", 0,
+            f"`{entry}` lowers with {count} `while` eqn(s) — a "
+            "data-dependent trip count in a served sampler; the drift gate "
+            "must stay a branch select inside the static scan"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # abstract trace signature (J006 building block — used by entries.py)
 # ---------------------------------------------------------------------------
 

@@ -176,6 +176,8 @@ def compute_fid(
     sampler: Optional[Callable] = None,
     cache_interval: int = 1,
     cache_mode: str = "delta",
+    cache_threshold: Optional[float] = None,
+    cache_tokens: Optional[int] = None,
 ) -> float:
     """FID of a diffusion model's samples against a real-image stream.
 
@@ -200,7 +202,9 @@ def compute_fid(
         imgs = (sampler(sub, sample_batch) if sampler is not None
                 else sampling.ddim_sample(model, params, sub, k=k, n=sample_batch,
                                           cache_interval=cache_interval,
-                                          cache_mode=cache_mode))
+                                          cache_mode=cache_mode,
+                                          cache_threshold=cache_threshold,
+                                          cache_tokens=cache_tokens))
         fake.update(np.asarray(feature_fn(imgs))[:keep])
         remaining -= keep
     return fid_from_stats(real, fake)
@@ -216,6 +220,10 @@ def cached_sampler_guard(
     k: int = 20,
     cache_interval: int = 2,
     cache_mode: str = "full",
+    cache_threshold: Optional[float] = None,
+    cache_tokens: Optional[int] = None,
+    task: str = "sample",
+    mask=None,
     inception_model=None,
     inception_variables=None,
 ) -> dict:
@@ -232,23 +240,57 @@ def cached_sampler_guard(
     (see :func:`make_feature_fn`) — fine here, because both streams go
     through the SAME extractor and only their distance is reported.
 
+    ``cache_threshold``/``cache_tokens`` pass through to the adaptive/token
+    modes (see ``ddim_sample``). ``task`` selects the guarded workload:
+    ``"sample"`` (plain generation) or ``"inpaint"``, which pairs the exact
+    and step-cached inpainting scans over the same known images (a fresh
+    uniform [−1,1] batch per step, drawn from the shared rng stream) and
+    ``mask`` (default: top half known) — guarding the editing path's cache
+    composition, where the per-step mask re-projection keeps feeding the
+    drift gate pixels the cache never predicted.
+
     Returns a dict with ``fid_exact_vs_cached``, ``max_abs_pixel_delta``
     (worst per-pixel divergence across every paired batch) and the sampler
     configuration, ready to land in a bench record.
     """
     from ddim_cold_tpu.ops import sampling
 
+    if task not in ("sample", "inpaint"):
+        raise ValueError(f"cached_sampler_guard task must be 'sample' or "
+                         f"'inpaint', got {task!r}")
     feature_fn, dim = make_feature_fn(inception_model, inception_variables)
     exact, cached = ActivationStats(dim), ActivationStats(dim)
+    H, W = model.img_size
+    if task == "inpaint" and mask is None:
+        mask = np.zeros((H, W), np.float32)
+        mask[: H // 2] = 1.0
     max_delta = 0.0
     remaining = n_samples
     while remaining > 0:
         keep = min(sample_batch, remaining)
         rng, sub = jax.random.split(rng)
-        imgs_e = sampling.ddim_sample(model, params, sub, k=k, n=sample_batch)
-        imgs_c = sampling.ddim_sample(model, params, sub, k=k, n=sample_batch,
-                                      cache_interval=cache_interval,
-                                      cache_mode=cache_mode)
+        if task == "inpaint":
+            from ddim_cold_tpu import workloads
+
+            known = jax.random.uniform(
+                jax.random.fold_in(sub, 0xFACE),
+                (sample_batch, H, W, model.in_chans),
+                jnp.float32, -1.0, 1.0)
+            imgs_e = workloads.inpaint(model, params, sub, known, mask, k=k)
+            imgs_c = workloads.inpaint(model, params, sub, known, mask, k=k,
+                                       cache_interval=cache_interval,
+                                       cache_mode=cache_mode,
+                                       cache_threshold=cache_threshold,
+                                       cache_tokens=cache_tokens)
+        else:
+            imgs_e = sampling.ddim_sample(model, params, sub, k=k,
+                                          n=sample_batch)
+            imgs_c = sampling.ddim_sample(model, params, sub, k=k,
+                                          n=sample_batch,
+                                          cache_interval=cache_interval,
+                                          cache_mode=cache_mode,
+                                          cache_threshold=cache_threshold,
+                                          cache_tokens=cache_tokens)
         max_delta = max(max_delta, float(jnp.max(jnp.abs(imgs_e - imgs_c))))
         exact.update(np.asarray(feature_fn(imgs_e))[:keep])
         cached.update(np.asarray(feature_fn(imgs_c))[:keep])
@@ -258,8 +300,11 @@ def cached_sampler_guard(
         "max_abs_pixel_delta": round(max_delta, 6),
         "n_samples": n_samples,
         "k": k,
+        "task": task,
         "cache_interval": cache_interval,
         "cache_mode": cache_mode,
+        "cache_threshold": cache_threshold,
+        "cache_tokens": cache_tokens,
         "extractor": ("canonical" if inception_variables is not None else
                       "seeded random-init proxy (paired streams, same "
                       "extractor — distance is meaningful, absolute FID "

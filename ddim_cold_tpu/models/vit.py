@@ -551,6 +551,9 @@ class DiffusionViT(nn.Module):
         skip_blocks: Optional[tuple] = None,
         block_delta: Optional[jax.Array] = None,
         capture_split: Optional[int] = None,
+        capture_tokens: bool = False,
+        token_cache: Optional[tuple] = None,
+        token_k: Optional[int] = None,
     ) -> jax.Array:
         """``stage`` partitions the forward for pipeline parallelism
         (parallel/pipeline.py): ``"embed"`` returns the token sequence after
@@ -578,7 +581,30 @@ class DiffusionViT(nn.Module):
         Both are static trace-time decisions — no device branching — and are
         mutually exclusive with each other, with ``scan_blocks`` (one scanned
         body cannot statically drop layers), with the attention probe, and
-        with partial ``stage`` forwards."""
+        with partial ``stage`` forwards.
+
+        Token-cache hooks (JiT-style spatial caching, arXiv:2603.10744 —
+        ``cache_mode="token"`` in ops/step_cache.py):
+
+        * ``capture_tokens=True`` — a *refresh* forward: run every block on
+          every token and return ``(x̂0, (ref_in, trunk_delta))`` where
+          ``ref_in`` is the post-embed token stream (the reference each
+          later step measures per-token change against) and ``trunk_delta``
+          is the (B, N+1, E) trunk displacement ``trunk_out − ref_in``.
+        * ``token_cache=(ref_in, trunk_delta)`` + ``token_k=k`` (static k)
+          — a *reuse* forward: score each token by its squared change vs
+          ``ref_in``, force the CLS token live, gather the top-k changed
+          tokens (indices SORTED into position order so k = N+1 degenerates
+          to the identity permutation and the step is bitwise the plain
+          forward), run the full trunk on only those k tokens, and scatter
+          the results into the cached stream ``tokens + trunk_delta``.
+          Returns ``(x̂0, (new_ref, new_delta))`` with the recomputed rows
+          refreshed in both cache leaves. Reuse steps pay the trunk at
+          sequence length k instead of N+1.
+
+        The token hooks carry the same static restrictions as the block-
+        delta hooks and are mutually exclusive with them (one cache family
+        per forward)."""
         if self.quant is not None:
             from ddim_cold_tpu.ops.quant import QUANT_MODES
 
@@ -614,6 +640,32 @@ class DiffusionViT(nn.Module):
         if capture_split is not None and not (1 <= capture_split < self.depth):
             raise ValueError(f"capture_split {capture_split} must split "
                              f"depth {self.depth} into two non-empty halves")
+        if capture_tokens or token_cache is not None:
+            if self.scan_blocks:
+                raise ValueError(
+                    "token caching (capture_tokens/token_cache) requires "
+                    "scan_blocks=False — the gathered subset changes the "
+                    "scanned body's shape")
+            if stage != "full":
+                raise ValueError("token caching composes with stage='full' only")
+            if return_attention_layer is not None:
+                raise ValueError("token caching excludes the attention probe")
+            if skip_blocks is not None or capture_split is not None:
+                raise ValueError(
+                    "token caching (capture_tokens/token_cache) and block-"
+                    "delta caching (skip_blocks/capture_split) are distinct "
+                    "cache families — pass one or the other")
+        if capture_tokens and token_cache is not None:
+            raise ValueError(
+                "capture_tokens (refresh step) and token_cache (reuse step) "
+                "are distinct cache branches — pass one or the other")
+        if token_cache is not None:
+            if token_k is None or not (1 <= token_k <= self.num_patches + 1):
+                raise ValueError(
+                    f"token_cache requires static token_k in "
+                    f"[1, {self.num_patches + 1}], got {token_k!r}")
+        elif token_k is not None:
+            raise ValueError("token_k only applies with token_cache")
         B = x.shape[0]
         E = self.embed_dim
         N = self.num_patches
@@ -663,6 +715,31 @@ class DiffusionViT(nn.Module):
         tokens = nn.Dropout(self.drop_rate, deterministic=deterministic, name="pos_drop")(tokens)
         if stage == "embed":
             return tokens
+
+        stream_in = tokens  # post-embed stream — the token-cache reference
+        live = None
+        if token_cache is not None:
+            ref_in, trunk_delta = token_cache
+            sub_in = tokens
+            # static degenerate k = N+1: every token is live, so the gather/
+            # scatter would be the identity — elide it at trace time, making
+            # this branch op-for-op the plain trunk (the BITWISE contract:
+            # fusion around a gather rounds differently inside a scan body)
+            if token_k < N + 1:
+                # per-token squared change vs the stream each token was last
+                # recomputed at; reductions in f32 so bf16 streams rank stably
+                scores = jnp.sum(
+                    jnp.square((tokens - ref_in).astype(jnp.float32)), axis=-1)
+                # CLS attends globally and feeds nothing to unpatchify's
+                # pixels directly, but every live token attends TO it — keep
+                # it fresh
+                scores = scores.at[:, 0].set(jnp.finfo(jnp.float32).max)
+                _, live = jax.lax.top_k(scores, token_k)  # (B, k) per-row
+                # sorted into position order so the gathered subsequence
+                # keeps the stream's relative layout
+                live = jnp.sort(live, axis=-1)
+                sub_in = jnp.take_along_axis(tokens, live[:, :, None], axis=1)
+            tokens = sub_in  # the trunk below runs at sequence length k
 
         # stochastic depth decay rule: linspace(0, rate, depth) (ViT.py:176)
         dpr = np.linspace(0.0, self.drop_path_rate, self.depth)
@@ -750,6 +827,21 @@ class DiffusionViT(nn.Module):
                 if capture_split is not None and i == capture_split - 1:
                     tokens_mid = tokens
 
+        if token_cache is not None:
+            sub_out = tokens  # (B, k, E) — trunk output of the live subset
+            if live is None:  # degenerate k = N+1 — full overwrite, no scatter
+                new_ref = sub_in
+                new_delta = (sub_out - sub_in).astype(trunk_delta.dtype)
+            else:
+                brow = jnp.arange(B)[:, None]
+                # stale tokens: last trunk output ≈ current embed + cached
+                # trunk displacement; live rows get this step's true output
+                tokens = stream_in + trunk_delta.astype(self.dtype)
+                tokens = tokens.at[brow, live].set(sub_out)
+                new_ref = ref_in.at[brow, live].set(sub_in)
+                new_delta = trunk_delta.at[brow, live].set(
+                    (sub_out - sub_in).astype(trunk_delta.dtype))
+
         trunk_out = tokens  # pre-norm trunk output — the delta reference point
         tokens = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm")(tokens)
         tokens = nn.Dense(
@@ -762,6 +854,10 @@ class DiffusionViT(nn.Module):
         out = self.unpatchify(tokens[:, 1:, :]).astype(jnp.float32)
         if capture_split is not None:
             return out, (tokens_mid - tokens_in, trunk_out - tokens_mid)
+        if capture_tokens:
+            return out, (stream_in, trunk_out - stream_in)
+        if token_cache is not None:
+            return out, (new_ref, new_delta)
         return out
 
     def unpatchify(self, x: jax.Array) -> jax.Array:

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddim_cold_tpu.ops import tiling
+
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
 
@@ -173,8 +175,11 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
     B, N, H, D = q.shape
     qh, kh, vh = (_to_heads(x, B, N, H, D) for x in (q, k, v))
     BH, Np, Dp = qh.shape
-    bq = min(block_q, Np)
-    bkv = min(block_kv, Np)
+    # pad-or-clamp the requested blocks to Mosaic-legal sizes for this
+    # dtype/N — min() alone produced illegal tiles at odd requests or
+    # sub-16 sublanes on bf16 (ops/tiling.py; N=2501 is the worst case)
+    bq = tiling.legal_block(block_q, Np, qh.dtype)
+    bkv = tiling.legal_block(block_kv, Np, qh.dtype)
     qh = _pad_to(qh, 1, bq)
     kh, vh = _pad_to(kh, 1, bkv), _pad_to(vh, 1, bkv)
     n_kv = kh.shape[1] // bkv
@@ -307,8 +312,8 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
     B, N, H, D = q.shape
     qh, kh, vh, oh, gh = (_to_heads(x, B, N, H, D) for x in (q, k, v, o, g))
     BH, Np, Dp = qh.shape
-    bq = min(block_q, Np)
-    bkv = min(block_kv, Np)
+    bq = tiling.legal_block(block_q, Np, qh.dtype)
+    bkv = tiling.legal_block(block_kv, Np, qh.dtype)
     qh, oh, gh = (_pad_to(x, 1, bq) for x in (qh, oh, gh))
     kh, vh = _pad_to(kh, 1, bkv), _pad_to(vh, 1, bkv)
     n_q, n_kv = qh.shape[1] // bq, kh.shape[1] // bkv

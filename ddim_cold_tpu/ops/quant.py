@@ -56,6 +56,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddim_cold_tpu.ops import tiling
+
 #: Pallas-TPU compiler params across jax versions (same shim as
 #: ops/flash_attention.py — renamed TPUCompilerParams → CompilerParams)
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
@@ -274,9 +276,15 @@ def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
     """
     M, K = x2d.shape
     _, N = w_int8.shape
-    bm = min(block_m, _round_up(M, 8))
-    bn = min(block_n, _round_up(N, _LANE))
-    bk = min(block_k, _round_up(K, _LANE))
+    # pad-or-clamp to Mosaic-legal blocks (ops/tiling.py): M is the
+    # activation's sublane dim (8 at f32, 16 at bf16); N is a lane dim; K is
+    # the activation's LANE dim and the int8 weight's SUBLANE dim at once,
+    # so it must also divide by int8's 32-sublane unit (128 % 32 == 0 —
+    # folded in explicitly so the constraint survives a lane-width change)
+    bm = tiling.legal_block(block_m, M, x2d.dtype)
+    bn = tiling.legal_block(block_n, N, jnp.float32, lane=True)
+    bk = tiling.legal_block(block_k, K, x2d.dtype, lane=True,
+                            min_unit=tiling.sublane_unit(jnp.int8))
     xp = _pad_axis(_pad_axis(x2d, 0, _round_up(M, bm)), 1, _round_up(K, bk))
     wp = _pad_axis(_pad_axis(w_int8, 0, _round_up(K, bk)), 1, _round_up(N, bn))
     sp = _pad_axis(scale.astype(jnp.float32)[None, :], 1, _round_up(N, bn))

@@ -104,9 +104,23 @@ def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
     return (x0_last + 1.0) / 2.0
 
 
+def _cached_spec(model, n_steps: int, cache_interval: int, cache_mode: str,
+                 cache_threshold, cache_tokens) -> step_cache.CacheSpec:
+    """One spec-construction site for every cached scan: supplies the
+    model-derived token count for "token" mode and forwards the adaptive
+    threshold / top-k statics so ops/step_cache.py's per-mode validation
+    fires identically from samplers, engine, and graftcheck mirrors."""
+    return step_cache.cache_spec(
+        model.depth, n_steps, cache_interval, cache_mode,
+        threshold=cache_threshold, token_k=cache_tokens,
+        n_tokens=(model.num_patches + 1) if cache_mode == "token" else None)
+
+
 def _ddim_cached_impl(model, params, x_init, noise_rng, cache0, *, k: int,
                       t_start: Optional[int], eta: float,
-                      cache_interval: int, cache_mode: str, sequence: bool):
+                      cache_interval: int, cache_mode: str,
+                      cache_threshold=None, cache_tokens=None,
+                      sequence: bool):
     """The feature-cached DDIM scan (ops/step_cache.py): same affine update
     as the plain scans, but the model evaluation routes through a
     ``lax.switch`` over the static refresh/reuse schedule and the block-delta
@@ -120,8 +134,8 @@ def _ddim_cached_impl(model, params, x_init, noise_rng, cache0, *, k: int,
     allocation across dispatches (the schedule's step 0 always refreshes, so
     stale contents are never read; serve/engine.py does exactly this)."""
     coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
-    spec = step_cache.cache_spec(model.depth, len(coeffs.t_seq),
-                                 cache_interval, cache_mode)
+    spec = _cached_spec(model, len(coeffs.t_seq), cache_interval, cache_mode,
+                        cache_threshold, cache_tokens)
     n = x_init.shape[0]
 
     def step(carry, inputs):
@@ -144,7 +158,8 @@ def _ddim_cached_impl(model, params, x_init, noise_rng, cache0, *, k: int,
 
 
 _CACHED_STATICS = ("model", "k", "t_start", "eta", "cache_interval",
-                   "cache_mode", "sequence")
+                   "cache_mode", "cache_threshold", "cache_tokens",
+                   "sequence")
 #: last-only entry point — donates x_init and the cache carry (both alias
 #: outputs: the image is x_init-shaped f32, the returned cache matches
 #: cache0), so the sampler never double-buffers x or the deltas in HBM.
@@ -204,12 +219,67 @@ _ddim_scan_inpaint_seq = jax.jit(_ddim_inpaint_impl,
                                  static_argnames=_INPAINT_STATICS)
 
 
-def _make_cache(model, x_init: jax.Array, mesh) -> step_cache.Cache:
+def _ddim_inpaint_cached_impl(model, params, x_init, known, mask, noise_rng,
+                              cache0, *, k: int, t_start: Optional[int],
+                              eta: float, cache_interval: int,
+                              cache_mode: str, cache_threshold=None,
+                              cache_tokens=None, sequence: bool):
+    """Feature-cached inpainting scan: ``_ddim_inpaint_impl``'s per-step
+    known-pixel projection composed with ``_ddim_cached_impl``'s step-cache
+    routing. The projection runs on the CLIPPED x̂0 — after the cache branch,
+    before the affine update — exactly where the plain inpaint scan applies
+    it, so ``cache_interval=1``-adjacent degenerate settings (adaptive
+    threshold 0, token k = n_tokens) stay bitwise against the plain scan.
+    Returns ``(images, final_cache)`` for the engine's per-bucket cache
+    recycling, like the other cached scans."""
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
+    spec = _cached_spec(model, len(coeffs.t_seq), cache_interval, cache_mode,
+                        cache_threshold, cache_tokens)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, _, cache = carry
+        (t, c1, c2, cz), br = inputs
+        x0_raw, cache = step_cache.apply_step(
+            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        x0 = jnp.clip(x0_raw, -1.0, 1.0)
+        x0 = mask * known + (1.0 - mask) * x0
+        x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
+        return (x_next, x0, cache), (x0 if sequence else None)
+
+    carry0 = (x_init, jnp.zeros_like(x_init), cache0)
+    branches = jnp.asarray(spec.branches, jnp.int32)
+    (_, x0_last, cache_out), x0_out = jax.lax.scan(
+        step, carry0, (_scan_inputs(coeffs), branches))
+    if sequence:
+        frames = jnp.concatenate([x_init[None], x0_out], axis=0)
+        return (frames + 1.0) / 2.0, cache_out
+    return (x0_last + 1.0) / 2.0, cache_out
+
+
+_INPAINT_CACHED_STATICS = ("model", "k", "t_start", "eta", "cache_interval",
+                           "cache_mode", "cache_threshold", "cache_tokens",
+                           "sequence")
+#: donation mirrors the cached sampler: x_init (fresh noise) and the cache
+#: carry alias outputs; known/mask are caller-owned conditioning, never
+#: donated.
+_ddim_scan_inpaint_cached = jax.jit(
+    _ddim_inpaint_cached_impl, static_argnames=_INPAINT_CACHED_STATICS,
+    donate_argnames=("x_init", "cache0"))
+_ddim_scan_inpaint_cached_seq = jax.jit(
+    _ddim_inpaint_cached_impl, static_argnames=_INPAINT_CACHED_STATICS)
+
+
+def _make_cache(model, x_init: jax.Array, mesh,
+                mode: str = "delta") -> step_cache.Cache:
     """Build the zero cache carry host-side and, under SPMD sampling, place
     it batch-sharded over the mesh's 'data' axis alongside the sample batch
-    — explicit placement, so the scan's cache shards never gather."""
+    — explicit placement, so the scan's cache shards never gather.
+    ``mode="adaptive"`` adds the drift-reference image leaf (x_init-shaped,
+    f32); the other modes share the two-leaf (B, N+1, E) pair."""
     cache = step_cache.init_cache(x_init.shape[0], model.num_patches + 1,
-                                  model.embed_dim, model.dtype)
+                                  model.embed_dim, model.dtype, mode=mode,
+                                  img_shape=x_init.shape[1:])
     return step_cache.shard_cache(cache, mesh)
 
 
@@ -239,6 +309,8 @@ def ddim_sample(
     eta: float = 0.0,
     cache_interval: int = 1,
     cache_mode: str = "delta",
+    cache_threshold: Optional[float] = None,
+    cache_tokens: Optional[int] = None,
 ) -> jax.Array:
     """k-strided DDIM sampling; returns images in [0, 1], NHWC.
 
@@ -264,6 +336,24 @@ def ddim_sample(
     instead. The schedule is static, so the scan stays one compiled program
     per (k, interval, mode). ``cache_interval=1`` (default) takes the plain
     scan — bit-for-bit the exact sampler. Requires ``scan_blocks=False``.
+
+    Two further modes (ops/step_cache.py, this is the adaptive-caching
+    surface):
+
+    * ``cache_mode="adaptive"`` + ``cache_threshold=τ`` — error-gated delta
+      reuse: the static schedule above becomes the worst-case bound, and a
+      cheap on-device drift estimate (normalized ‖x − x_ref‖², max over the
+      batch) overrides any reuse step back to a refresh whenever drift ≥ τ.
+      Still one compiled program (data-dependent ``lax.switch`` index over
+      the same static branch set), no host sync. τ=0.0 refreshes every step
+      — bitwise the exact sampler. τ→∞ is bitwise the static "delta" mode.
+    * ``cache_mode="token"`` + ``cache_tokens=k_tok`` — JiT spatial caching:
+      non-refresh steps recompute only the ``k_tok`` most-changed tokens
+      (CLS always live) through the trunk, scattering into the cached token
+      stream. ``k_tok = num_patches + 1`` is bitwise the exact sampler.
+
+    Both statics are part of the compiled-program key; they are rejected
+    (by ops/step_cache.cache_spec) under any other ``cache_mode``.
     """
     if eta and rng is None:
         raise ValueError("eta > 0 draws per-step noise — pass rng")
@@ -286,9 +376,11 @@ def ddim_sample(
     if step_cache.enabled(cache_interval):
         fn = _ddim_scan_cached_seq if return_sequence else _ddim_scan_cached
         out, _ = fn(
-            model, params, x_init, noise_rng, _make_cache(model, x_init, mesh),
+            model, params, x_init, noise_rng,
+            _make_cache(model, x_init, mesh, cache_mode),
             k=k, t_start=t_start, eta=eta, cache_interval=cache_interval,
-            cache_mode=cache_mode, sequence=return_sequence)
+            cache_mode=cache_mode, cache_threshold=cache_threshold,
+            cache_tokens=cache_tokens, sequence=return_sequence)
         return out
     if return_sequence:
         return _ddim_scan_sequence(model, params, x_init, noise_rng,
@@ -303,7 +395,9 @@ def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
                 return_sequence: bool = False,
                 mesh=None,
                 cache_interval: int = 1,
-                cache_mode: str = "delta") -> jax.Array:
+                cache_mode: str = "delta",
+                cache_threshold: Optional[float] = None,
+                cache_tokens: Optional[int] = None) -> jax.Array:
     """Guided sampling: DDIM-denoise an encoded image from level ``t_start``.
 
     Strictly a prefix-truncated ``ddim_sample`` (SURVEY.md C24). The
@@ -318,7 +412,8 @@ def sample_from(model, params, x_init: jax.Array, t_start: int, k: int = 10,
     return ddim_sample(model, params, rng, x_init=x_init, t_start=t_start,
                        k=k, eta=eta, return_sequence=return_sequence,
                        mesh=mesh, cache_interval=cache_interval,
-                       cache_mode=cache_mode)
+                       cache_mode=cache_mode, cache_threshold=cache_threshold,
+                       cache_tokens=cache_tokens)
 
 
 def slerp(a: jax.Array, b: jax.Array, frac: jax.Array) -> jax.Array:
@@ -421,13 +516,15 @@ _cold_scan_seq = jax.jit(_cold_impl, static_argnames=_COLD_STATICS)
 
 def _cold_cached_impl(model, params, x_init, cache0, *, levels: int,
                       return_sequence: bool, cache_interval: int,
-                      cache_mode: str):
+                      cache_mode: str, cache_threshold=None,
+                      cache_tokens=None):
     """Feature-cached cold-diffusion scan — same naive Algorithm-1 update as
     ``_cold_scan``, model evaluation routed through the step cache. Returns
     ``(images, final_cache)`` like ``_ddim_cached_impl`` (donation aliasing +
     serve-loop cache recycling)."""
     t_seq = jnp.asarray(schedule.cold_time_sequence(levels))
-    spec = step_cache.cache_spec(model.depth, levels, cache_interval, cache_mode)
+    spec = _cached_spec(model, levels, cache_interval, cache_mode,
+                        cache_threshold, cache_tokens)
     n = x_init.shape[0]
 
     def step(carry, inputs):
@@ -448,7 +545,8 @@ def _cold_cached_impl(model, params, x_init, cache0, *, levels: int,
 
 
 _COLD_CACHED_STATICS = ("model", "levels", "return_sequence",
-                        "cache_interval", "cache_mode")
+                        "cache_interval", "cache_mode", "cache_threshold",
+                        "cache_tokens")
 _cold_scan_cached = jax.jit(_cold_cached_impl,
                             static_argnames=_COLD_CACHED_STATICS,
                             donate_argnames=("x_init", "cache0"))
@@ -468,6 +566,8 @@ def cold_sample(
     mesh=None,
     cache_interval: int = 1,
     cache_mode: str = "delta",
+    cache_threshold: Optional[float] = None,
+    cache_tokens: Optional[int] = None,
 ) -> jax.Array:
     """Cold-diffusion sampling from per-sample constant-color "noise".
 
@@ -497,9 +597,10 @@ def cold_sample(
     if step_cache.enabled(cache_interval):
         fn = _cold_scan_cached_seq if return_sequence else _cold_scan_cached
         out, _ = fn(
-            model, params, x_init, _make_cache(model, x_init, mesh),
+            model, params, x_init, _make_cache(model, x_init, mesh, cache_mode),
             levels=levels, return_sequence=return_sequence,
-            cache_interval=cache_interval, cache_mode=cache_mode)
+            cache_interval=cache_interval, cache_mode=cache_mode,
+            cache_threshold=cache_threshold, cache_tokens=cache_tokens)
         return out
     fn = _cold_scan_seq if return_sequence else _cold_scan
     return fn(model, params, x_init, levels=levels,

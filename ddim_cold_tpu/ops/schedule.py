@@ -140,6 +140,7 @@ CACHE_REFRESH = 0  # full forward, (re)populate the block-delta cache
 CACHE_REUSE_REAR = 1  # skip the REAR trunk half, apply its cached delta
 CACHE_REUSE_FRONT = 2  # skip the FRONT trunk half, apply its cached delta
 CACHE_REUSE_ALL = 1  # ("full" mode) skip the whole trunk, apply both deltas
+CACHE_REUSE_TOKEN = 1  # ("token" mode) recompute only the top-k changed tokens
 
 
 def cache_branch_sequence(n_steps: int, cache_interval: int,
@@ -159,13 +160,24 @@ def cache_branch_sequence(n_steps: int, cache_interval: int,
     * ``"full"`` — reuse steps skip the whole trunk (CACHE_REUSE_ALL): only
       the embed/head run against the fresh (x_t, t). Skips all block FLOPs
       per reuse step; the cheaper/looser end of the trade-off.
+    * ``"adaptive"`` — SAME array as ``"delta"``: this is the static
+      worst-case bound of the error-gated sampler (ops/step_cache.py). The
+      branch-0 steps here are the guaranteed refreshes; the REAR/FRONT ids on
+      the reuse steps are what the on-device drift gate may override back to
+      CACHE_REFRESH (a data-dependent ``lax.switch`` index over the same
+      static branch set — still one compiled program, still no host sync).
+    * ``"token"`` — JiT-style spatial caching (arXiv:2603.10744): reuse
+      steps take CACHE_REUSE_TOKEN, recomputing only a static top-k changed
+      token subset through the trunk (models/vit.py ``token_cache``).
 
     ``cache_interval <= 1`` returns all-refresh (caching disabled; the
     samplers bypass the cache machinery entirely for bit-exactness with the
     plain scan).
     """
-    if cache_mode not in ("delta", "full"):
-        raise ValueError(f"cache_mode must be 'delta' or 'full', got {cache_mode!r}")
+    if cache_mode not in ("delta", "full", "adaptive", "token"):
+        raise ValueError(
+            "cache_mode must be one of 'delta', 'full', 'adaptive', 'token', "
+            f"got {cache_mode!r}")
     branch = np.zeros(n_steps, dtype=np.int32)
     if cache_interval <= 1:
         return branch
@@ -173,7 +185,9 @@ def cache_branch_sequence(n_steps: int, cache_interval: int,
     reuse = (idx % cache_interval) != 0
     if cache_mode == "full":
         branch[reuse] = CACHE_REUSE_ALL
-    else:
+    elif cache_mode == "token":
+        branch[reuse] = CACHE_REUSE_TOKEN
+    else:  # "delta" and its error-gated upgrade "adaptive" share the pattern
         early = idx < (n_steps + 1) // 2
         branch[reuse & early] = CACHE_REUSE_REAR
         branch[reuse & ~early] = CACHE_REUSE_FRONT

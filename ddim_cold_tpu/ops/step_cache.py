@@ -1,5 +1,6 @@
 """Training-free DDIM step caching — reuse transformer block deltas across
-adjacent sampler steps (Δ-DiT, arXiv:2406.01125).
+adjacent sampler steps (Δ-DiT, arXiv:2406.01125) with error-gated and
+token-level adaptive variants (JiT, arXiv:2603.10744).
 
 Adjacent reverse-diffusion steps feed the ViT nearly identical activations, so
 the token-stream displacement a contiguous run of residual blocks contributes
@@ -8,6 +9,33 @@ steps. This module caches those deltas on periodic *refresh* steps and, on the
 *reuse* steps in between, replaces the skipped blocks with one add of the
 cached delta — no retraining, no extra parameters, and (empirically, Δ-DiT)
 nearly FID-neutral at small intervals.
+
+Two adaptive modes extend the fixed-interval schedule, both keeping the
+sampler ONE compiled ``lax.scan`` program:
+
+* ``"adaptive"`` — error-gated refresh. The cache carry grows a third leaf,
+  ``x_ref``: the scan state at the last refresh. Each step computes a cheap
+  normalized drift ``max_rows ‖x − x_ref‖² / (‖x_ref‖² + ε)`` on device and
+  overrides the static REAR/FRONT reuse id back to CACHE_REFRESH whenever
+  drift ≥ ``spec.threshold``. The ``lax.switch`` index becomes data-dependent
+  but ranges over the SAME static branch set, so there is no retrace and no
+  host sync; the static ``"delta"``-pattern schedule is the worst-case bound
+  (the gate can only add refreshes). The drift reduction is a batch ``max``
+  on purpose: it makes the gate invariant to padding rows that replicate an
+  existing row (serve/engine.py pads adaptive batches with row-0 replicas),
+  preserving the engine's bitwise-vs-direct contract. ``threshold == 0``
+  forces every step to refresh — bitwise the exact sampler; ``threshold =
+  inf`` never fires — bitwise the static ``"delta"`` schedule.
+
+* ``"token"`` — per-token spatial caching. The carry is ``(ref_in, delta)``,
+  both (B, N+1, E): the post-embed token stream at the last refresh and the
+  whole-trunk cumulative delta. A reuse step embeds the fresh input, ranks
+  tokens by squared change against ``ref_in``, gathers the static top-k most
+  changed (CLS always live), runs ONLY those through the trunk, and scatters
+  the results back into ``embed + delta`` (models/vit.py ``token_cache``).
+  Per-row top-k keeps rows independent of batchmates, so normal engine
+  coalescing remains bitwise. ``token_k == n_tokens`` degenerates to the
+  identity gather/scatter — bitwise the exact sampler.
 
 Design constraints inherited from ops/sampling.py:19-22 — the samplers are
 single jitted ``lax.scan`` loops with no host↔device traffic until the final
@@ -42,20 +70,31 @@ import jax.numpy as jnp
 
 from ddim_cold_tpu.ops import schedule
 
-#: cache pytree: (delta_front, delta_rear), each (B, N+1, E) in the model's
-#: compute dtype. Kept as a flat tuple so the scan carry stays a plain pytree.
+#: cache pytree, by mode — kept as a flat tuple so the scan carry stays a
+#: plain pytree:
+#:   "delta"/"full": (delta_front, delta_rear), each (B, N+1, E) model dtype
+#:   "adaptive":     (delta_front, delta_rear, x_ref), x_ref (B, H, W, C) f32
+#:   "token":        (ref_in, trunk_delta), each (B, N+1, E) model dtype
 Cache = tuple
+
+#: denominator guard in the normalized drift estimate (f32; well below any
+#: real ‖x_ref‖² for an image-shaped state, only there for the zero carry)
+DRIFT_EPS = 1e-6
 
 
 class CacheSpec(NamedTuple):
     """Static description of one cached-sampling run — hashable, so jitted
-    samplers can close over it keyed by their (k, interval, mode) statics."""
+    samplers can close over it keyed by their (k, interval, mode, threshold,
+    token_k) statics."""
 
     depth: int  # model trunk depth
     split: int  # front half = blocks [0, split), rear = [split, depth)
-    mode: str  # "delta" | "full"
+    mode: str  # "delta" | "full" | "adaptive" | "token"
     interval: int  # refresh stride (1 = caching disabled)
     branches: tuple  # per-step branch ids (static schedule)
+    threshold: float = 0.0  # "adaptive": drift level that forces a refresh
+    token_k: int = 0  # "token": tokens recomputed per reuse step (incl. CLS)
+    n_tokens: int = 0  # "token": total tokens N+1 (for validation/accounting)
 
     @property
     def n_steps(self) -> int:
@@ -71,11 +110,19 @@ def enabled(cache_interval: Optional[int]) -> bool:
 
 def cache_spec(depth: int, n_steps: int, cache_interval: int,
                cache_mode: str = "delta",
-               split: Optional[int] = None) -> CacheSpec:
+               split: Optional[int] = None,
+               threshold: Optional[float] = None,
+               token_k: Optional[int] = None,
+               n_tokens: Optional[int] = None) -> CacheSpec:
     """Build the static spec for a run of ``n_steps`` reverse steps.
 
     ``split`` defaults to ``depth // 2`` — the Δ-DiT front/rear halving. The
     model must have ≥ 2 blocks (a 1-block trunk has no half to skip).
+    ``cache_mode="adaptive"`` requires ``threshold`` (≥ 0 — the drift level
+    that forces a refresh; 0 refreshes every step). ``cache_mode="token"``
+    requires ``token_k`` in [1, n_tokens] and ``n_tokens`` (the model's
+    N+1). Each knob is rejected outside its mode so a silently ignored
+    setting can't masquerade as an active one.
     """
     if depth < 2:
         raise ValueError(f"step caching needs depth >= 2 blocks, got {depth}")
@@ -83,19 +130,56 @@ def cache_spec(depth: int, n_steps: int, cache_interval: int,
         split = depth // 2
     if not (1 <= split < depth):
         raise ValueError(f"split {split} must lie in [1, {depth})")
+    if cache_mode == "adaptive":
+        if threshold is None or not (float(threshold) >= 0.0):
+            raise ValueError(
+                "cache_mode='adaptive' needs a drift threshold >= 0, got "
+                f"{threshold!r}")
+    elif threshold is not None:
+        raise ValueError(
+            f"threshold only applies to cache_mode='adaptive' (got mode "
+            f"{cache_mode!r} with threshold {threshold!r})")
+    if cache_mode == "token":
+        if n_tokens is None or n_tokens < 2:
+            raise ValueError(
+                f"cache_mode='token' needs the model's n_tokens (N+1) >= 2, "
+                f"got {n_tokens!r}")
+        if token_k is None or not (1 <= token_k <= n_tokens):
+            raise ValueError(
+                f"cache_mode='token' needs token_k in [1, {n_tokens}], got "
+                f"{token_k!r}")
+    elif token_k is not None or n_tokens is not None:
+        raise ValueError(
+            f"token_k/n_tokens only apply to cache_mode='token' (got mode "
+            f"{cache_mode!r})")
     branches = schedule.cache_branch_sequence(n_steps, cache_interval, cache_mode)
     return CacheSpec(depth=depth, split=int(split), mode=cache_mode,
                      interval=int(cache_interval),
-                     branches=tuple(int(b) for b in branches))
+                     branches=tuple(int(b) for b in branches),
+                     threshold=float(threshold or 0.0),
+                     token_k=int(token_k or 0), n_tokens=int(n_tokens or 0))
 
 
-def init_cache(n: int, n_tokens: int, embed_dim: int, dtype) -> Cache:
-    """Zero-filled cache carry. The schedule's step 0 is always a refresh, so
-    the zeros are never consumed — they only fix the carry's shape/dtype.
-    The two halves must be DISTINCT allocations: the cached samplers donate
-    the carry, and donating one buffer under two arguments is invalid."""
-    return (jnp.zeros((n, n_tokens, embed_dim), dtype),
+def init_cache(n: int, n_tokens: int, embed_dim: int, dtype,
+               mode: str = "delta",
+               img_shape: Optional[tuple] = None) -> Cache:
+    """Zero-filled cache carry. The schedule's step 0 is always a refresh
+    (and in adaptive mode the gate is overridden to refresh there regardless
+    of what drift the stale ``x_ref`` implies), so the zeros are never
+    consumed — they only fix the carry's shape/dtype. Leaves must be
+    DISTINCT allocations: the cached samplers donate the carry, and donating
+    one buffer under two arguments is invalid.
+
+    ``mode="adaptive"`` adds the f32 ``x_ref`` leaf and needs ``img_shape``
+    = (H, W, C); ``mode="token"`` reuses the two-leaf (B, N+1, E) structure
+    as (ref_in, trunk_delta)."""
+    pair = (jnp.zeros((n, n_tokens, embed_dim), dtype),
             jnp.zeros((n, n_tokens, embed_dim), dtype))
+    if mode != "adaptive":
+        return pair
+    if img_shape is None:
+        raise ValueError("init_cache(mode='adaptive') needs img_shape=(H, W, C)")
+    return pair + (jnp.zeros((n, *img_shape), jnp.float32),)
 
 
 def shard_cache(cache: Cache, mesh) -> Cache:
@@ -118,8 +202,60 @@ def apply_step(model, params, x: jax.Array, t_vec: jax.Array,
     ``spec.branches``); returns ``(x0_raw, new_cache)``. Every branch returns
     the same pytree structure, so ``lax.switch`` compiles all of them into
     the one scan program — the refresh/reuse decision costs no host sync.
+    In ``"adaptive"`` mode the switch index additionally folds in the
+    on-device drift gate: still the same static branch set, so the program
+    has a data-dependent branch INDEX but no data-dependent structure.
     """
     depth, split = spec.depth, spec.split
+
+    if spec.mode == "token":
+        def refresh_tokens(x, cache):
+            x0, tok = model.apply({"params": params}, x, t_vec,
+                                  capture_tokens=True)
+            return x0, tok
+
+        def reuse_token(x, cache):
+            x0, new_cache = model.apply({"params": params}, x, t_vec,
+                                        token_cache=cache,
+                                        token_k=spec.token_k)
+            return x0, new_cache
+
+        return jax.lax.switch(branch, (refresh_tokens, reuse_token), x, cache)
+
+    if spec.mode == "adaptive":
+        def refresh(x, cache):
+            x0, deltas = model.apply({"params": params}, x, t_vec,
+                                     capture_split=split)
+            return x0, deltas + (x.astype(jnp.float32),)
+
+        def reuse_rear(x, cache):
+            x0 = model.apply({"params": params}, x, t_vec,
+                             skip_blocks=(split, depth), block_delta=cache[1])
+            return x0, cache
+
+        def reuse_front(x, cache):
+            x0 = model.apply({"params": params}, x, t_vec,
+                             skip_blocks=(0, split), block_delta=cache[0])
+            return x0, cache
+
+        # drift per ROW, reduced with max: the gate is a batch-level scalar
+        # (lax.switch takes one index) but the max keeps it invariant to
+        # padding rows that replicate a real row (serve/engine.py). `>=`
+        # makes threshold=0 an always-refresh gate — bitwise the exact
+        # sampler; a stale/zero x_ref is harmless because step 0's branch id
+        # is CACHE_REFRESH and the jnp.where below pins idx to 0 there no
+        # matter what drift evaluates to.
+        x_ref = cache[2]
+        axes = tuple(range(1, x_ref.ndim))
+        xf = x.astype(jnp.float32)
+        num = jnp.sum(jnp.square(xf - x_ref), axis=axes)
+        den = jnp.sum(jnp.square(x_ref), axis=axes) + DRIFT_EPS
+        drift = jnp.max(num / den)
+        idx = jnp.where((branch == schedule.CACHE_REFRESH)
+                        | (drift >= spec.threshold),
+                        schedule.CACHE_REFRESH, branch)
+        return jax.lax.switch(idx, (refresh, reuse_rear, reuse_front),
+                              x, cache)
 
     def refresh(x, cache):
         x0, deltas = model.apply({"params": params}, x, t_vec,
@@ -152,7 +288,13 @@ def apply_step(model, params, x: jax.Array, t_vec: jax.Array,
 def flops_saved_fraction(spec: CacheSpec) -> float:
     """Fraction of the run's BLOCK compute the schedule skips (embed/head and
     the schedule itself excluded) — the analytic ceiling on the speedup's
-    compute term, quoted next to measured numbers in bench/PERF.md."""
+    compute term, quoted next to measured numbers in bench/PERF.md.
+
+    For ``"adaptive"`` this is the gate-never-fires ceiling (every forced
+    refresh the gate adds eats into it); for ``"token"`` a reuse step still
+    runs ``token_k`` of ``n_tokens`` tokens through the trunk, so it saves
+    the complementary fraction of the linear-in-token block cost (attention's
+    quadratic term makes this a slight underestimate of the true saving)."""
     if not spec.branches:
         return 0.0
     saved = 0.0
@@ -161,6 +303,8 @@ def flops_saved_fraction(spec: CacheSpec) -> float:
             continue
         if spec.mode == "full":
             saved += 1.0  # the whole trunk skipped
+        elif spec.mode == "token":
+            saved += 1.0 - spec.token_k / spec.n_tokens
         elif b == schedule.CACHE_REUSE_REAR:
             saved += (spec.depth - spec.split) / spec.depth
         else:  # CACHE_REUSE_FRONT

@@ -1,0 +1,68 @@
+"""TPU tile-geometry legality for the Pallas kernels (ops/flash_attention.py,
+ops/quant.py).
+
+Mosaic accepts a VMEM block only when each of its last two dims is either a
+multiple of the dtype's minimum tile — sublane × lane: f32 (8, 128),
+bf16/f16 (16, 128), int8 (32, 128) — or spans the whole array dim on that
+axis. The kernels' old ``min(requested, dim)`` clamp could produce illegal
+shapes: a hand-tuned odd block at a non-divisible token count (N = 2501, the
+200px/p4 model, is the in-repo worst case) passes CPU interpret mode — which
+does NOT enforce the rule and is what CI exercises — then Mosaic rejects it
+in the one hardware window. A sub-16 sublane block on a bf16 model fails the
+same way even at aligned Ns.
+
+:func:`legal_block` is the single pad-or-clamp policy both kernels now
+route every requested block size through. Pure host arithmetic on static
+shapes — the regression tests assert legality at the exact 200px geometries
+without a TPU attached.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: TPU lane width — minimum last-dim tile unit for every dtype
+LANE = 128
+
+
+def round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def sublane_unit(dtype) -> int:
+    """Minimum second-minor (sublane) block unit for ``dtype``: 8 at 32-bit,
+    16 at 16-bit, 32 at 8-bit — packing narrower dtypes keeps one
+    (unit, 128) tile at the same 4 KiB of VMEM."""
+    bits = np.dtype(dtype).itemsize * 8
+    try:
+        return {32: 8, 16: 16, 8: 32}[bits]
+    except KeyError:
+        raise ValueError(
+            f"no TPU tile rule for {np.dtype(dtype)} ({bits}-bit)") from None
+
+
+def legal_block(requested: int, dim: int, dtype, *, lane: bool = False,
+                min_unit: int = 1) -> int:
+    """Clamp a requested Pallas block size to a Mosaic-legal one for an
+    array dim of ``dim`` elements of ``dtype``.
+
+    ``lane=False`` legalizes a sublane (second-minor) block dim,
+    ``lane=True`` a lane (minor) one. ``min_unit`` folds in an extra
+    divisibility constraint when one block size tiles two arrays of
+    different dtypes (e.g. the dequant matmul's K block is the activation's
+    lane dim AND the int8 weight's sublane dim).
+
+    Policy: round the request UP to the unit (never down — a shrunk block
+    re-tiles the grid, a grown one only pads VMEM), then clamp to the
+    unit-padded dim so a single block spans small arrays. The caller pads
+    the array to a multiple of the returned block, which the unit-multiple
+    guarantee keeps legal.
+    """
+    if requested < 1:
+        raise ValueError(f"block size must be >= 1, got {requested}")
+    if dim < 1:
+        raise ValueError(f"array dim must be >= 1, got {dim}")
+    unit = LANE if lane else sublane_unit(dtype)
+    unit = unit * min_unit // np.gcd(unit, min_unit)  # lcm
+    full = round_up(dim, unit)
+    return min(round_up(requested, unit), full)

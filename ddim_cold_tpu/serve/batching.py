@@ -26,7 +26,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 _SAMPLERS = ("ddim", "cold")
-_CACHE_MODES = ("delta", "full")
+_CACHE_MODES = ("delta", "full", "adaptive", "token")
 _QUANT_MODES = (None, "xla", "pallas")  # ops/quant.py QUANT_MODES + off
 #: workloads.TASKS, duplicated as literals (this module is host-only —
 #: graftcheck A004 — and the workloads package imports jax); the two tuples
@@ -50,7 +50,14 @@ class SamplerConfig:
     t_start: Optional[int] = None  # guided start level (ddim only)
     levels: int = 6                # cold-diffusion levels (cold only)
     cache_interval: int = 1        # 1 = exact sampler; >1 = step cache
-    cache_mode: str = "delta"
+    cache_mode: str = "delta"      # "delta" | "full" | "adaptive" | "token"
+    cache_threshold: Optional[float] = None  # "adaptive" only: drift gate τ
+    # (≥ 0; 0.0 = refresh every step = bitwise exact). Static — part of the
+    # compiled-program key, mirrored by ops/step_cache.cache_spec validation.
+    cache_tokens: int = 0          # "token" only: static top-k live tokens
+    # per reuse step (≥ 1; = num_patches+1 is bitwise exact — the model-
+    # dependent upper bound is enforced at program build, not here: this
+    # module is host-only and never sees the model).
     quant: Optional[str] = None    # None = float params; "xla" | "pallas" =
     # the w8a16 trunk (ops/quant.py) over the engine's int8 param tree
     task: str = "sample"           # "sample" = plain generation; an editing
@@ -77,6 +84,28 @@ class SamplerConfig:
         if self.cache_mode not in _CACHE_MODES:
             raise ValueError(f"cache_mode must be one of {_CACHE_MODES}, "
                              f"got {self.cache_mode!r}")
+        if self.cache_mode == "adaptive":
+            if self.cache_threshold is None:
+                raise ValueError(
+                    "cache_mode='adaptive' needs cache_threshold=<drift "
+                    "gate, ≥ 0.0> (0.0 refreshes every step — bitwise the "
+                    "exact sampler)")
+            if not float(self.cache_threshold) >= 0.0:  # rejects NaN too
+                raise ValueError("cache_threshold must be >= 0.0, "
+                                 f"got {self.cache_threshold!r}")
+        elif self.cache_threshold is not None:
+            raise ValueError(
+                "cache_threshold is the 'adaptive' drift gate — meaningless "
+                f"under cache_mode={self.cache_mode!r}")
+        if self.cache_mode == "token":
+            if self.cache_tokens < 1:
+                raise ValueError(
+                    "cache_mode='token' needs cache_tokens=<static top-k "
+                    f"live tokens, >= 1>, got {self.cache_tokens}")
+        elif self.cache_tokens != 0:
+            raise ValueError(
+                "cache_tokens is the 'token' top-k — meaningless under "
+                f"cache_mode={self.cache_mode!r}")
         if self.quant not in _QUANT_MODES:
             raise ValueError(f"quant must be one of {_QUANT_MODES}, "
                              f"got {self.quant!r}")
@@ -100,14 +129,27 @@ class SamplerConfig:
                 raise ValueError(
                     f"task {self.task!r} decodes from an intermediate noise "
                     "level — t_start= is required")
-        if self.task == "inpaint" and self.cache_interval != 1:
-            raise ValueError(
-                "task 'inpaint' has no step-cached scan variant (the mask "
-                "projection lives in its own scan) — use cache_interval=1")
-
     @property
     def cached(self) -> bool:
         return self.cache_interval > 1
+
+    @property
+    def batch_coupled(self) -> bool:
+        """True when one compiled dispatch couples its rows: the adaptive
+        drift gate reduces per-row drift with a batch MAX before the
+        ``lax.switch`` — a hot batchmate can force a refresh that changes
+        every row's arithmetic. Coupled configs must never coalesce or split
+        requests (the planner gives each request its own batch; the engine
+        pads with row-0 replicas, whose drift equals row 0's and so never
+        moves the max) or the bitwise-vs-direct contract breaks. Token mode
+        is NOT coupled: its top-k indices are per-row, so it coalesces and
+        splits freely — but its bitwise-vs-direct guarantee is per dispatch
+        SHAPE (exact-bucket dispatches are bitwise the own-n direct call;
+        padded dispatches are bitwise a direct call at the padded shape and
+        float-level vs own-n, because the reuse step's gathered
+        sub-sequence trunk compiles per batch shape and short-sequence GEMM
+        tiling rounds per-row differently across shapes)."""
+        return self.cached and self.cache_mode == "adaptive"
 
 
 class Ticket:
@@ -411,6 +453,14 @@ def plan_batches(requests: Sequence, buckets: Sequence[int]) -> list[BatchPlan]:
     group's total rows are covered by ``cover_rows``; rows then pack densely
     into the chosen buckets in request order, splitting requests at batch
     boundaries. Only the LAST batch of a group carries padding.
+
+    Batch-coupled configs (``SamplerConfig.batch_coupled`` — the adaptive
+    drift gate) are the exception: each request becomes its OWN single
+    batch in the smallest bucket that fits it whole (never coalesced with a
+    batchmate, never split — either would change the batch the gate's max
+    reduction sees and break bitwise-vs-direct). A coupled request larger
+    than the biggest bucket is rejected here, which surfaces as a submit
+    error.
     """
     groups: dict[SamplerConfig, list] = {}
     for req in requests:
@@ -420,6 +470,19 @@ def plan_batches(requests: Sequence, buckets: Sequence[int]) -> list[BatchPlan]:
 
     plans: list[BatchPlan] = []
     for config, reqs in groups.items():
+        if config.batch_coupled:
+            for req in reqs:
+                bucket = select_bucket(req.n, buckets)
+                if bucket is None:
+                    raise ValueError(
+                        f"adaptive-cache request of {req.n} rows exceeds the "
+                        f"largest bucket {max(buckets)} — the drift gate "
+                        "couples the batch, so the request cannot split; "
+                        "submit at most max(buckets) rows per request")
+                plans.append(BatchPlan(config=config, bucket=bucket,
+                                       entries=((req, 0, req.n, 0),),
+                                       rows=req.n))
+            continue
         total = sum(r.n for r in reqs)
         sizes = cover_rows(total, buckets)
         it = iter(reqs)

@@ -165,7 +165,8 @@ class Engine:
         # (eta is pinned to 0.0 at program build — see module docstring)
         self._key0 = jax.random.PRNGKey(0)
         self._programs: dict = {}
-        self._spare_caches: dict = {}  # bucket -> recycled step-cache carry
+        # (bucket, kind) -> recycled step-cache carry; kind per _cache_kind
+        self._spare_caches: dict = {}
         # w8a16 serving (ops/quant.py): the int8 tree is built ONCE from the
         # float params on the first quant config and shipped/pinned like the
         # float tree — every quant dispatch reuses the same device buffers
@@ -363,10 +364,18 @@ class Engine:
         return jax.ShapeDtypeStruct((bucket, H, W, self.model.in_chans),
                                     jnp.float32, sharding=sharding)
 
-    def _cache_struct(self, bucket: int):
+    def _cache_struct(self, bucket: int, config: SamplerConfig):
         shape = (bucket, self.model.num_patches + 1, self.model.embed_dim)
         sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         s = jax.ShapeDtypeStruct(shape, self.model.dtype, sharding=sharding)
+        if config.cache_mode == "adaptive":
+            # the drift gate's reference image rides the carry (f32,
+            # x-shaped) — see ops/step_cache.init_cache
+            H, W = self.model.img_size
+            x_ref = jax.ShapeDtypeStruct(
+                (bucket, H, W, self.model.in_chans), jnp.float32,
+                sharding=sharding)
+            return (s, s, x_ref)
         return (s, s)
 
     def _mask_struct(self, bucket: int):
@@ -392,6 +401,10 @@ class Engine:
         model, params = self._model_for(config), self._params_for(config)
         seq = config.preview_every > 0
         if config.task == "inpaint":
+            if config.cached:
+                return _inpaint_cached_lower(
+                    model, params, x, self._mask_struct(bucket), self._key0,
+                    self._cache_struct(bucket, config), config, seq)
             fn = (sampling._ddim_scan_inpaint_seq if seq
                   else sampling._ddim_scan_inpaint)
             return fn.lower(
@@ -401,15 +414,16 @@ class Engine:
         if config.sampler == "cold":
             if config.cached:
                 return _cold_cached_lower(model, params, x,
-                                          self._cache_struct(bucket), config,
-                                          seq)
+                                          self._cache_struct(bucket, config),
+                                          config, seq)
             fn = sampling._cold_scan_seq if seq else sampling._cold_scan
             return fn.lower(
                 model, params, x, levels=config.levels,
                 return_sequence=seq).compile()
         if config.cached:
             return _ddim_cached_lower(model, params, x, self._key0,
-                                      self._cache_struct(bucket), config, seq)
+                                      self._cache_struct(bucket, config),
+                                      config, seq)
         fn = sampling._ddim_scan_sequence if seq else sampling._ddim_scan_last
         return fn.lower(
             model, params, x, self._key0, k=config.k,
@@ -469,15 +483,29 @@ class Engine:
         ``xs`` a tuple: the init batch first, then any per-task extras
         (``_EXTRA_INPUTS`` — inpaint's known/mask ride along, sliced and
         padded exactly like x; zero-padding rows carry mask 0, so they pass
-        through the projection untouched)."""
+        through the projection untouched).
+
+        Batch-coupled (adaptive-gate) plans pad with ROW-0 REPLICAS of every
+        input instead of zeros: the pad rows then evolve bit-identically to
+        row 0, so their per-row drift equals row 0's and the gate's batch-max
+        reduction is exactly what the direct unpadded call computes — the
+        bitwise-vs-direct contract survives padding."""
         self._mark(f"assemble bucket={plan.bucket}")
         faults.fire("serve.assemble", tag=self._tag(plan))
+        coupled = plan.config.batch_coupled
+
+        def _pad(real_parts):
+            first = real_parts[0]
+            if coupled:
+                return jnp.broadcast_to(
+                    first[:1], (plan.padded_rows,) + first.shape[1:])
+            return jnp.zeros((plan.padded_rows,) + first.shape[1:],
+                             jnp.float32)
+
         parts = [self._request_init(req)[lo:hi]
                  for req, lo, hi, _ in plan.entries]
         if plan.padded_rows:
-            H, W = self.model.img_size
-            parts.append(jnp.zeros((plan.padded_rows, H, W,
-                                    self.model.in_chans), jnp.float32))
+            parts.append(_pad(parts))
         x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
         if self.mesh is not None:
             x = jax.device_put(x, batch_sharding(self.mesh))
@@ -486,8 +514,7 @@ class Engine:
             cols = [jnp.asarray(req.extras[name][lo:hi], jnp.float32)
                     for req, lo, hi, _ in plan.entries]
             if plan.padded_rows:
-                cols.append(jnp.zeros(
-                    (plan.padded_rows,) + cols[0].shape[1:], jnp.float32))
+                cols.append(_pad(cols))
             e = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=0)
             if self.mesh is not None:
                 e = jax.device_put(e, batch_sharding(self.mesh))
@@ -507,14 +534,31 @@ class Engine:
 
     # ------------------------------------------------------------- dispatch
 
-    def _take_cache(self, bucket: int):
-        cache = self._spare_caches.pop(bucket, None)
+    def _cache_kind(self, config: SamplerConfig) -> str:
+        """Spare-cache pool key suffix: delta/full/token all share the
+        two-leaf (B, N+1, E) carry structure ("pair" — a recycled carry is
+        interchangeable between them because every schedule's step 0
+        refreshes before reading), while adaptive's third x_ref leaf needs
+        its own pool."""
+        return "adaptive" if config.cache_mode == "adaptive" else "pair"
+
+    def _take_cache(self, bucket: int, config: SamplerConfig):
+        cache = self._spare_caches.pop((bucket, self._cache_kind(config)),
+                                       None)
         if cache is None:
+            H, W = self.model.img_size
             cache = step_cache.init_cache(bucket, self.model.num_patches + 1,
                                           self.model.embed_dim,
-                                          self.model.dtype)
+                                          self.model.dtype,
+                                          mode=config.cache_mode,
+                                          img_shape=(H, W,
+                                                     self.model.in_chans))
             cache = step_cache.shard_cache(cache, self.mesh)
         return cache
+
+    def _recycle_cache(self, bucket: int, config: SamplerConfig,
+                       cache_out) -> None:
+        self._spare_caches[(bucket, self._cache_kind(config))] = cache_out
 
     def _dispatch(self, plan: BatchPlan, xs):
         prog = self.ensure_program(plan.config, plan.bucket)
@@ -523,20 +567,27 @@ class Engine:
         faults.fire("serve.dispatch", tag=self._tag(plan))
         if plan.config.task == "inpaint":
             x, known, m = xs
-            out = prog(params, x, known, m, self._key0)
+            if plan.config.cached:
+                out, cache_out = prog(
+                    params, x, known, m, self._key0,
+                    self._take_cache(plan.bucket, plan.config))
+                self._recycle_cache(plan.bucket, plan.config, cache_out)
+            else:
+                out = prog(params, x, known, m, self._key0)
         elif plan.config.sampler == "cold":
             x, = xs
             if plan.config.cached:
-                out, cache_out = prog(params, x,
-                                      self._take_cache(plan.bucket))
-                self._spare_caches[plan.bucket] = cache_out
+                out, cache_out = prog(
+                    params, x, self._take_cache(plan.bucket, plan.config))
+                self._recycle_cache(plan.bucket, plan.config, cache_out)
             else:
                 out = prog(params, x)
         elif plan.config.cached:
             x, = xs
-            out, cache_out = prog(params, x, self._key0,
-                                  self._take_cache(plan.bucket))
-            self._spare_caches[plan.bucket] = cache_out
+            out, cache_out = prog(
+                params, x, self._key0,
+                self._take_cache(plan.bucket, plan.config))
+            self._recycle_cache(plan.bucket, plan.config, cache_out)
         else:
             x, = xs
             out = prog(params, x, self._key0)
@@ -879,7 +930,9 @@ def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig,
     return fn.lower(
         model, params, x, key, cache, k=config.k, t_start=config.t_start,
         eta=0.0, cache_interval=config.cache_interval,
-        cache_mode=config.cache_mode, sequence=seq).compile()
+        cache_mode=config.cache_mode,
+        cache_threshold=config.cache_threshold,
+        cache_tokens=config.cache_tokens or None, sequence=seq).compile()
 
 
 def _cold_cached_lower(model, params, x, cache, config: SamplerConfig,
@@ -889,4 +942,20 @@ def _cold_cached_lower(model, params, x, cache, config: SamplerConfig,
     return fn.lower(
         model, params, x, cache, levels=config.levels, return_sequence=seq,
         cache_interval=config.cache_interval,
-        cache_mode=config.cache_mode).compile()
+        cache_mode=config.cache_mode,
+        cache_threshold=config.cache_threshold,
+        cache_tokens=config.cache_tokens or None).compile()
+
+
+def _inpaint_cached_lower(model, params, x, mask, key, cache,
+                          config: SamplerConfig, seq: bool = False):
+    # known shares x's struct: both are (bucket, H, W, C) f32 batch-sharded
+    fn = (sampling._ddim_scan_inpaint_cached_seq if seq
+          else sampling._ddim_scan_inpaint_cached)
+    return fn.lower(
+        model, params, x, x, mask, key, cache, k=config.k,
+        t_start=config.t_start, eta=0.0,
+        cache_interval=config.cache_interval,
+        cache_mode=config.cache_mode,
+        cache_threshold=config.cache_threshold,
+        cache_tokens=config.cache_tokens or None, sequence=seq).compile()

@@ -110,14 +110,21 @@ def superres_init(low_res, size: int) -> np.ndarray:
 
 def inpaint(model, params, rng: jax.Array, known, mask, *, k: int = 10,
             t_start: Optional[int] = None, eta: float = 0.0,
+            cache_interval: int = 1, cache_mode: str = "delta",
+            cache_threshold: Optional[float] = None,
+            cache_tokens: Optional[int] = None,
             return_sequence: bool = False) -> jax.Array:
     """Training-free inpainting: DDIM from fresh noise with per-step mask
     re-projection of the known pixels (ops/sampling._ddim_inpaint_impl).
     ``known`` is the reference image in [−1, 1]; ``mask`` selects the pixels
     to preserve (see :func:`normalize_mask`). Known pixels of the result are
-    ``(known + 1) / 2`` bit-exactly. Served form:
+    ``(known + 1) / 2`` bit-exactly — the projection runs after the cache
+    branch in the cached variant too, so this holds at every
+    ``cache_interval``/``cache_mode``. Served form:
     ``SamplerConfig(task="inpaint")`` + ``submit(seed=, x_init=known,
-    mask=)``."""
+    mask=)``. ``cache_interval`` > 1 routes through the step-cached inpaint
+    scan (all four cache modes; see ``ddim_sample`` for the
+    adaptive/token statics)."""
     known = jnp.asarray(known, jnp.float32)
     if known.ndim == 3:
         known = known[None]
@@ -128,6 +135,19 @@ def inpaint(model, params, rng: jax.Array, known, mask, *, k: int = 10,
     # same fold as ddim_sample: the (eta>0-only) per-step noise key must not
     # correlate with the init draw; eta=0 (the served path) never reads it
     noise_rng = jax.random.fold_in(rng, 0xD1F)
+    if cache_interval > 1:
+        from ddim_cold_tpu.ops import step_cache
+
+        cache0 = step_cache.init_cache(
+            n, model.num_patches + 1, model.embed_dim, model.dtype,
+            mode=cache_mode, img_shape=(H, W, model.in_chans))
+        fn = (sampling._ddim_scan_inpaint_cached_seq if return_sequence
+              else sampling._ddim_scan_inpaint_cached)
+        out, _ = fn(model, params, x_init, known, m, noise_rng, cache0, k=k,
+                    t_start=t_start, eta=eta, cache_interval=cache_interval,
+                    cache_mode=cache_mode, cache_threshold=cache_threshold,
+                    cache_tokens=cache_tokens, sequence=return_sequence)
+        return out
     fn = (sampling._ddim_scan_inpaint_seq if return_sequence
           else sampling._ddim_scan_inpaint)
     return fn(model, params, x_init, known, m, noise_rng, k=k,
@@ -136,6 +156,8 @@ def inpaint(model, params, rng: jax.Array, known, mask, *, k: int = 10,
 
 def super_resolve(model, params, low_res, *, level: int,
                   cache_interval: int = 1, cache_mode: str = "delta",
+                  cache_threshold: Optional[float] = None,
+                  cache_tokens: Optional[int] = None,
                   return_sequence: bool = False, mesh=None) -> jax.Array:
     """Training-free super-resolution: treat the low-res input as the cold
     degradation at ``level`` (it IS one — nearest-downsampling is the cold
@@ -150,12 +172,16 @@ def super_resolve(model, params, low_res, *, level: int,
                                 levels=int(level),
                                 return_sequence=return_sequence, mesh=mesh,
                                 cache_interval=cache_interval,
-                                cache_mode=cache_mode)
+                                cache_mode=cache_mode,
+                                cache_threshold=cache_threshold,
+                                cache_tokens=cache_tokens)
 
 
 def draft_to_drawing(model, params, rng: jax.Array, draft, *,
                      t_start: int = 1800, k: int = 10,
                      cache_interval: int = 1, cache_mode: str = "delta",
+                     cache_threshold: Optional[float] = None,
+                     cache_tokens: Optional[int] = None,
                      return_sequence: bool = False, mesh=None) -> jax.Array:
     """The reference's headline app (ViT_draft2drawing.py:394-408):
     forward-noise a rough draft to an intermediate ``t_start``, then DDIM
@@ -169,7 +195,9 @@ def draft_to_drawing(model, params, rng: jax.Array, draft, *,
     return sampling.sample_from(model, params, encoded, t_start, k=k,
                                 return_sequence=return_sequence, mesh=mesh,
                                 cache_interval=cache_interval,
-                                cache_mode=cache_mode)
+                                cache_mode=cache_mode,
+                                cache_threshold=cache_threshold,
+                                cache_tokens=cache_tokens)
 
 
 #: slerp interpolation promoted to a first-class task: the direct form is

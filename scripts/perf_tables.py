@@ -63,12 +63,29 @@ def render(path: str) -> str:
 
     ns = {s: sub.get("sampler_throughput_200px_k20" + s)
           for s in ("", "_dense", "_flash", "_xla", "_flash_n64",
-                    "_cached", "_cached_delta", "_flash_w8a16")}
+                    "_cached", "_cached_delta", "_cached_adaptive",
+                    "_cached_token", "_flash_w8a16")}
     if any(ns.values()):
         lines.append("")
         lines.append("**200px k=20 north-star (img/s/chip):** "
                      + " · ".join(f"{(s or '_best')[1:]}={v['value']}"
                                   for s, v in ns.items() if v))
+    ad = ns.get("_cached_adaptive")
+    if ad:
+        lines.append(
+            f"adaptive cache leg: τ={ad.get('cache_threshold')} @ "
+            f"interval={ad.get('cache_interval')} · "
+            f"{ad.get('speedup_vs_exact_flash')}× vs exact flash"
+            + (f" · {ad['speedup_vs_fixed_delta']}× vs fixed delta i2"
+               if ad.get("speedup_vs_fixed_delta") is not None else "")
+            + f" · pixel drift {ad.get('max_abs_pixel_delta')}")
+    tk = ns.get("_cached_token")
+    if tk:
+        lines.append(
+            f"token cache leg: top-k={tk.get('cache_tokens')} @ "
+            f"interval={tk.get('cache_interval')} · "
+            f"{tk.get('speedup_vs_exact_flash')}× vs exact flash · "
+            f"pixel drift {tk.get('max_abs_pixel_delta')}")
     w8 = ns.get("_flash_w8a16")
     if w8:
         lines.append(
@@ -122,6 +139,28 @@ def render(path: str) -> str:
                 f"({sq.get('vs_float_serving')}× float serving) · param bytes "
                 f"{sq.get('param_bytes')} → {sq.get('param_bytes_quant')} · "
                 f"compiles after warmup {sq.get('compiles_after_warmup')}")
+
+    ca = sub.get("cache_adaptive")
+    if ca:
+        lines.append("")
+        lines.append(
+            "**adaptive cache (one-shot img/s):** "
+            + " · ".join(f"{name}={leg['img_per_sec']} "
+                         f"({leg['vs_fixed_i2']}× fixed-i2)"
+                         for name, leg in ca.items()
+                         if isinstance(leg, dict) and "img_per_sec" in leg)
+            + f" · τ→0 bitwise {ca.get('threshold0_bitwise_exact')}")
+        sv = ca.get("served", {})
+        if sv:
+            lines.append(
+                "adaptive cache served: "
+                + " · ".join(f"{name}={leg['img_per_sec']} img/s"
+                             for name, leg in sv.items()
+                             if isinstance(leg, dict))
+                + f" · warmup compiles {sv.get('warmup_new_compiles')} · "
+                  "compiles after warmup "
+                + "/".join(str(leg.get("compiles_after_warmup"))
+                           for leg in sv.values() if isinstance(leg, dict)))
 
     fl = sub.get("faults")
     if fl:

@@ -113,6 +113,25 @@ def test_j005_host_callback_in_scan():
         "ok", "ok.py") == []
 
 
+def test_j007_while_primitive_flagged():
+    # a while_loop anywhere in the program (nested under jit included) is a
+    # data-dependent trip count — the exact thing the adaptive drift gate
+    # must never introduce into a served sampler
+    f = jax.jit(lambda x: jax.lax.while_loop(
+        lambda v: v < 10.0, lambda v: v + 1.0, x))
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((), jnp.float32))
+    fs = jaxpr_checks.check_static_trip_count(closed, "fix", "fix.py")
+    assert _rules_of(fs) == ["GRAFT-J007"]
+    assert fs[0].subject == "fix:while"
+
+    # a static-trip scan (the gate's actual home) is clean
+    g = jax.jit(lambda x: jax.lax.scan(
+        lambda c, _: (c + 1.0, None), x, None, length=4)[0])
+    assert jaxpr_checks.check_static_trip_count(
+        jax.make_jaxpr(g)(jax.ShapeDtypeStruct((), jnp.float32)),
+        "ok", "ok.py") == []
+
+
 # -------------------------------------------------- serve signature (J006)
 
 
@@ -333,8 +352,8 @@ def test_cli_fix_baseline_then_clean(tmp_path, monkeypatch):
 def test_rule_table_covers_all_emitted_rules():
     assert set(RULES) == {
         "GRAFT-J001", "GRAFT-J002", "GRAFT-J003", "GRAFT-J004", "GRAFT-J005",
-        "GRAFT-J006", "GRAFT-A001", "GRAFT-A002", "GRAFT-A003", "GRAFT-A004",
-        "GRAFT-S001", "GRAFT-S002"}
+        "GRAFT-J006", "GRAFT-J007", "GRAFT-A001", "GRAFT-A002", "GRAFT-A003",
+        "GRAFT-A004", "GRAFT-S001", "GRAFT-S002"}
 
 
 # ------------------------------------------------------------- clean tree

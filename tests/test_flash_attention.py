@@ -256,17 +256,23 @@ def test_model_attention_probe_still_works_with_flash():
 
 def _tile_rule_spy(monkeypatch, fa):
     """Install a pallas_call spy asserting every BlockSpec satisfies the TPU
-    (8, 128) tile rule against the real call arguments; returns the call-name
-    list for count assertions."""
+    tile rule — dtype-aware sublane unit (f32 8, bf16 16, int8 32) × lane
+    128, each dim exempt when the block spans the whole array dim — against
+    the real call arguments; returns the call-name list for count
+    assertions. CPU interpret mode does not enforce the rule, so this spy is
+    what stands between a green CI and a Mosaic rejection on chip."""
     from jax.experimental import pallas as pl
 
-    def check(block, arr, ctx):
-        assert len(block) == len(arr), (ctx, block, arr)
+    from ddim_cold_tpu.ops import tiling
+
+    def check(block, arr, dtype, ctx):
+        assert len(block) == len(arr.shape), (ctx, block, arr.shape)
         if len(block) < 2:
             return
-        (bs, bl), (asub, alane) = block[-2:], arr[-2:]
-        assert bs % 8 == 0 or bs == asub, (ctx, block, arr)
-        assert bl % 128 == 0 or bl == alane, (ctx, block, arr)
+        (bs, bl), (asub, alane) = block[-2:], arr.shape[-2:]
+        unit = tiling.sublane_unit(dtype)
+        assert bs % unit == 0 or bs == asub, (ctx, block, arr.shape, unit)
+        assert bl % 128 == 0 or bl == alane, (ctx, block, arr.shape)
 
     real = pl.pallas_call
     calls = []
@@ -279,13 +285,13 @@ def _tile_rule_spy(monkeypatch, fa):
             calls.append(name)
             in_specs = kw["in_specs"]
             for i, (spec, op) in enumerate(zip(in_specs, ops)):
-                check(spec.block_shape, op.shape, f"{name} in[{i}]")
+                check(spec.block_shape, op, op.dtype, f"{name} in[{i}]")
             outs = kw["out_shape"]
             outs = outs if isinstance(outs, (list, tuple)) else [outs]
             specs = kw["out_specs"]
             specs = specs if isinstance(specs, (list, tuple)) else [specs]
             for i, (spec, o) in enumerate(zip(specs, outs)):
-                check(spec.block_shape, o.shape, f"{name} out[{i}]")
+                check(spec.block_shape, o, o.dtype, f"{name} out[{i}]")
             return inner(*ops)
 
         return wrapper
@@ -332,6 +338,53 @@ def test_block_specs_satisfy_tpu_tile_rule(monkeypatch):
         assert np.isfinite(np.asarray(g)).all()
     # per shape: primal fwd + vjp fwd + dq + dkv
     assert calls.count("_fwd_kernel") == 6 and len(calls) == 12, calls
+
+
+def test_odd_requested_blocks_legalized_at_200px(monkeypatch):
+    """Regression for the 200px tile-legality bug: a hand-tuned block size
+    that doesn't divide the dtype's tile unit (say 300, or N itself at
+    N=2501) used to flow straight into the BlockSpecs via ``min(block, N)``
+    — silently fine under CPU interpret, a Mosaic reject on chip. Every
+    request must now be legalized (ops/tiling.legal_block), forward and
+    backward, f32 and bf16, at both 200px token counts (p4 N=2501,
+    p8 N=626)."""
+    from ddim_cold_tpu.ops import flash_attention as fa
+
+    calls = _tile_rule_spy(monkeypatch, fa)
+    cases = [(2501, jnp.float32, 300, 500), (2501, jnp.float32, 2501, 2501),
+             (626, jnp.bfloat16, 100, 104), (626, jnp.bfloat16, 8, 632)]
+    for N, dtype, bq, bkv in cases:
+        q, k, v = (x.astype(dtype) for x in _rand_qkv(13, 1, N, 1, 64))
+        scale = 64**-0.5
+        out = fa.flash_attention(q, k, v, scale, bq, bkv)
+        assert np.isfinite(np.asarray(out, np.float32)).all(), (N, bq, bkv)
+        g = jax.grad(lambda q: fa.flash_attention(
+            q, k, v, scale, bq, bkv).astype(jnp.float32).sum())(q)
+        assert np.isfinite(np.asarray(g, np.float32)).all(), (N, bq, bkv)
+    assert calls.count("_fwd_kernel") == 2 * len(cases), calls
+
+
+def test_legal_block_policy():
+    """The pad-or-clamp helper itself (pure host arithmetic)."""
+    from ddim_cold_tpu.ops import tiling
+
+    assert tiling.legal_block(256, 2504, jnp.float32) == 256
+    assert tiling.legal_block(300, 2504, jnp.float32) == 304   # round up
+    assert tiling.legal_block(300, 2504, jnp.bfloat16) == 304  # 304 % 16 == 0
+    assert tiling.legal_block(100, 2504, jnp.bfloat16) == 112
+    assert tiling.legal_block(4096, 626, jnp.bfloat16) == 640  # clamp to dim⁺
+    assert tiling.legal_block(8, 2504, jnp.bfloat16) == 16     # sub-unit
+    assert tiling.legal_block(100, 384, jnp.float32, lane=True) == 128
+    # K of the dequant matmul: lane for the activation AND int8 sublane
+    assert tiling.legal_block(100, 384, jnp.bfloat16, lane=True,
+                              min_unit=32) == 128
+    assert tiling.sublane_unit(jnp.float32) == 8
+    assert tiling.sublane_unit(jnp.bfloat16) == 16
+    assert tiling.sublane_unit(jnp.int8) == 32
+    with pytest.raises(ValueError):
+        tiling.legal_block(0, 64, jnp.float32)
+    with pytest.raises(ValueError):
+        tiling.sublane_unit(jnp.float64)
 
 
 def _sub_jaxprs(val):

@@ -161,6 +161,31 @@ def test_pallas_multichunk_k_accumulation():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_odd_requested_blocks_legalized_at_200px(monkeypatch):
+    """Regression for the 200px tile-legality bug, quant edition: odd
+    hand-tuned (block_m, block_n, block_k) used to reach the BlockSpecs via
+    ``min(block, dim)`` — fine under CPU interpret, a Mosaic reject on chip.
+    K is the hardest dim: it is the activation's LANE dim and the int8
+    weight's SUBLANE dim (unit 32) at the same time. Shapes are the exact
+    200px trunk matmuls: p8 tokens (626, 384) @ fc1, p4 tokens 2501."""
+    from test_flash_attention import _tile_rule_spy
+
+    calls = _tile_rule_spy(monkeypatch, quant)  # only uses the shared pl
+    cases = [((626, 384, 1536), jnp.bfloat16, (100, 300, 100)),
+             ((2501, 384, 384), jnp.float32, (300, 100, 384))]
+    for (M, Kd, N), dtype, (bm, bn, bk) in cases:
+        x = jax.random.normal(jax.random.PRNGKey(8), (M, Kd), dtype)
+        w_int8, scale = quant.quantize_weight(
+            jax.random.normal(jax.random.PRNGKey(9), (Kd, N)))
+        got = np.asarray(quant._dequant_matmul_pallas(
+            x, w_int8, scale, block_m=bm, block_n=bn, block_k=bk),
+            np.float32)
+        want = np.asarray(quant._dequant_matmul_xla(
+            x.astype(jnp.float32), w_int8, scale))
+        np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+    assert len(calls) == len(cases), calls
+
+
 def test_dequant_matmul_validation():
     x = jnp.zeros((2, 4))
     w_int8, scale = quant.quantize_weight(jnp.ones((4, 3)))

@@ -344,8 +344,8 @@ def test_config_validation():
         serve.SamplerConfig(task="superres")  # superres is the cold path
     with pytest.raises(ValueError, match="t_start"):
         serve.SamplerConfig(task="draft", k=K)
-    with pytest.raises(ValueError, match="step-cached"):
-        serve.SamplerConfig(task="inpaint", k=K, cache_interval=2)
+    # inpaint + step cache became a served product in the adaptive-cache PR
+    assert serve.SamplerConfig(task="inpaint", k=K, cache_interval=2).cached
     with pytest.raises(ValueError, match="preview_every"):
         serve.SamplerConfig(k=K, preview_every=-1)
 
