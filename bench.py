@@ -218,6 +218,18 @@ def main(argv=None):
                          "after one warmup — RAISES if anything compiles "
                          "after warmup; composes with --smoke for the "
                          "CPU CI gate")
+    ap.add_argument("--parallel", action="store_true",
+                    help="run the sequence-parallel serving leg (parallel/ "
+                         "ulysses + the (data, seq) mesh programs): warms "
+                         "one engine at sp_degree ∈ {1, 2, all-local} and "
+                         "records single-request latency and img/s per "
+                         "degree — the batch-vs-sequence crossover evidence "
+                         "for PERF.md. RAISES if anything compiles after "
+                         "warmup or if the degenerate sp_degree=1 program "
+                         "is not bitwise the direct sampler (on CPU those "
+                         "structural contracts ARE the leg; the >1.3× "
+                         "latency gate only arms on real chips); composes "
+                         "with --smoke for the CPU CI gate")
     ap.add_argument("--xla-blockwise", action="store_true",
                     help="also time the pure-XLA blockwise attention leg in "
                          "the north-star section (retired from the default "
@@ -823,6 +835,155 @@ def main(argv=None):
         if args.cache_adaptive:
             section("cache_adaptive", run_cache_adaptive)
 
+        def run_parallel():
+            # the sequence-parallel leg (parallel/ulysses + the per-degree
+            # (data, seq) meshes): the SAME full-bucket request served at
+            # sp_degree ∈ {1, 2, all-local}. Two structural contracts hold
+            # everywhere and ARE the leg on CPU CI: zero compiles after
+            # warmup at every degree (an sp program is one AOT executable,
+            # registry-keyed by (config, bucket) like any other), and the
+            # degenerate sp_degree=1 bitwise-equal to the direct sampler.
+            # sp>1 is allclose vs degree 1 (shard_map reorders reductions)
+            # and records single-request latency per degree — the
+            # batch-vs-sequence crossover evidence PERF.md publishes. The
+            # >1.3× sp2-vs-sp1 latency gate only arms on real chips, where
+            # sharding actually drops per-device FLOPs; CPU "devices" share
+            # the same cores and the ratio is noise.
+            from ddim_cold_tpu import serve
+            from ddim_cold_tpu.ops import sampling
+
+            n_dev = jax.local_device_count()
+            if n_dev < 2:
+                sub["parallel"] = {"skipped": (
+                    f"{n_dev} local device(s) — sequence parallelism "
+                    "shards over >= 2")}
+                log("parallel: skipped (single local device)")
+                return
+            k_sp = 400 if args.smoke else 20
+            degrees = [1]
+            if n_dev % 2 == 0:
+                degrees.append(2)
+            if n_dev > 2:
+                degrees.append(n_dev)  # all-local: seq over every device
+            # one bucket every geometry can tile: the data axis at degree d
+            # is n_dev // d, and ensure_program rejects a bucket the data
+            # axis does not divide (the sp batch is data-sharded)
+            bucket = max(2, max((n_dev // d for d in degrees if d > 1),
+                                default=2))
+            cfgs = {1: serve.SamplerConfig(k=k_sp)}
+            for d in degrees[1:]:
+                cfgs[d] = serve.SamplerConfig(k=k_sp, sp_mode="ulysses",
+                                              sp_degree=d)
+            engine = serve.Engine(model, state.params, buckets=(bucket,))
+            mark(f"parallel warmup degrees={degrees} bucket={bucket}",
+                 budget_s=2 * stall_s)
+            wu = serve.warmup(engine, list(cfgs.values()))
+            outs, rows, compiles = {}, {}, 0
+            for d in degrees:
+                best = None
+                for rep in range(2):  # keep the faster drain
+                    mark(f"parallel drain sp{d} rep {rep}")
+                    t = engine.submit(seed=800, n=bucket, config=cfgs[d])
+                    t0 = time.perf_counter()
+                    r = engine.run()
+                    wall = time.perf_counter() - t0
+                    compiles += r["compiles"]
+                    outs[d] = np.asarray(t.result(timeout=600))
+                    best = wall if best is None else min(best, wall)
+                # ulysses needs the local head count divisible by the seq
+                # axis; models.sp_clone falls back to ring otherwise
+                resolved = ("ring" if d > 1 and model.num_heads % d
+                            else cfgs[d].sp_mode)
+                rows[d] = {
+                    "sp_mode": resolved,
+                    "mesh": {"data": n_dev // d, "seq": d} if d > 1 else None,
+                    "latency_s": round(best, 4),
+                    "img_per_sec": round(bucket / best, 2)}
+            direct = np.asarray(sampling.ddim_sample(
+                model, state.params, jax.random.PRNGKey(800), k=k_sp,
+                n=bucket))
+            bitwise = bool(np.array_equal(outs[1], direct))
+            # sp tolerance is dtype-aware: this model's trunk is bf16, where
+            # ONE reordered reduction moves an activation by ~1 ulp (0.0039
+            # at 1.0) — the fp32 tests' 2e-5 would flag pure quantization
+            sp_atol = 0.02 if model.dtype == jnp.bfloat16 else 2e-5
+            for d in degrees[1:]:
+                rows[d]["max_abs_delta_vs_sp1"] = round(
+                    float(np.max(np.abs(outs[d] - outs[1]))), 6)
+                rows[d]["speedup_vs_sp1"] = round(
+                    rows[1]["latency_s"] / rows[d]["latency_s"], 3)
+            sub["parallel"] = {
+                "devices": n_dev, "bucket": bucket, "k": k_sp,
+                "sp_atol": sp_atol,
+                "degrees": {str(d): rows[d] for d in degrees},
+                "sp1_bitwise_vs_direct": bitwise,
+                "compiles_after_warmup": compiles,
+                "warmup_new_compiles": wu["new_compiles"],
+                "warmup_programs": wu["programs"],
+            }
+            log("parallel: " + ", ".join(
+                f"sp{d} {rows[d]['latency_s']}s ({rows[d]['sp_mode']})"
+                for d in degrees) + f"; compiles after warmup: {compiles}")
+            if not bitwise:
+                raise RuntimeError(
+                    "sp_degree=1 is not bitwise the direct sampler — the "
+                    "degenerate config must BE the existing program")
+            for d in degrees[1:]:
+                if not np.allclose(outs[d], outs[1], atol=sp_atol):
+                    raise RuntimeError(
+                        f"sp_degree={d} drifted "
+                        f"{rows[d]['max_abs_delta_vs_sp1']} from the "
+                        f"degree-1 program (atol {sp_atol}) — beyond the "
+                        "sharded-reduction tolerance")
+            if compiles:
+                raise RuntimeError(
+                    f"parallel leg compiled {compiles} program(s) after "
+                    "warmup — every sp geometry must be AOT-warmed")
+            if jax.default_backend() != "cpu" and 2 in rows:
+                if rows[2]["speedup_vs_sp1"] < 1.3:
+                    raise RuntimeError(
+                        f"sp_degree=2 single-request speedup "
+                        f"{rows[2]['speedup_vs_sp1']} < 1.3x — sequence "
+                        "parallelism is not paying for its collectives on "
+                        "this chip")
+            if not args.smoke and jax.default_backend() != "cpu":
+                # the north-star 200px geometry, k=20, sharded across ALL
+                # local devices through a warmed engine — the single-request
+                # latency the seq axis exists to cut (2501 tokens is where
+                # attention dominates and the all-to-all pays). data axis is
+                # 1 at the all-local degree, so any bucket tiles it.
+                d200 = degrees[-1]
+                ns = DiffusionViT(dtype=jnp.bfloat16,
+                                  **MODEL_CONFIGS["oxford_flower_200_p4"])
+                mark("parallel 200px param init", budget_s=2 * stall_s)
+                nsp = ns.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 200, 200, 3)),
+                              jnp.zeros((1,), jnp.int32))["params"]
+                cfg200 = serve.SamplerConfig(k=20, sp_mode="ulysses",
+                                             sp_degree=d200)
+                eng200 = serve.Engine(ns, nsp, buckets=(4,))
+                mark(f"parallel 200px warmup sp{d200}", budget_s=3 * stall_s)
+                serve.warmup(eng200, [cfg200])
+                t200 = eng200.submit(seed=801, n=4, config=cfg200)
+                t0 = time.perf_counter()
+                r200 = eng200.run()
+                wall = time.perf_counter() - t0
+                np.asarray(t200.result(timeout=600))
+                sub["parallel"]["northstar_200px_sp"] = {
+                    "sp_degree": d200, "bucket": 4, "k": 20,
+                    "latency_s": round(wall, 3),
+                    "img_per_sec": round(4 / wall, 2),
+                    "compiles_after_warmup": r200["compiles"]}
+                log(f"parallel 200px sp{d200}: {wall:.2f}s for 4 imgs; "
+                    f"compiles after warmup: {r200['compiles']}")
+                if r200["compiles"]:
+                    raise RuntimeError(
+                        "200px sp leg compiled after warmup — the sharded "
+                        "north-star program must be AOT too")
+
+        if args.parallel:
+            section("parallel", run_parallel)
+
         def run_faults():
             # the robustness leg: same mixed stream twice through a
             # fault-tolerant engine — once DISARMED (the zero-overhead
@@ -1068,6 +1229,33 @@ def main(argv=None):
                     "rows": best["rows"], "batches": best["batches"]}
                 log(f"edit {task}: {best['img_per_sec']:.2f} img/s over "
                     f"{best['rows']} rows ({best['batches']} batches)")
+            # low-res consistency: one more superres drain whose output,
+            # projected onto its anchors (workloads.superres_project), must
+            # downsample BIT-EXACTLY back to the conditioning input — the
+            # data-consistency contract eval/fid.py publishes. The RAW
+            # output's anchor drift rides along as a quality metric: the
+            # naive Algorithm-1 cold update predicts anchor pixels rather
+            # than carrying them, so raw is never bit-exact by itself.
+            from ddim_cold_tpu.eval import fid as fid_mod
+            mark("edit superres consistency")
+            t_sr = engine.submit(
+                x_init=workloads.superres_init(low[:bmax], H),
+                config=cfgs["superres"])
+            r = engine.run()
+            compiles += r["compiles"]
+            sr_out = np.asarray(t_sr.result(timeout=600))
+            raw_g = fid_mod.superres_consistency_guard(sr_out, low[:bmax])
+            g = fid_mod.superres_consistency_guard(
+                workloads.superres_project(sr_out, low[:bmax]), low[:bmax])
+            per_task["superres"]["consistency"] = {
+                "bit_exact": g["bit_exact"],
+                "anchor_pixels": g["anchor_pixels"],
+                "raw_max_abs_delta": raw_g["max_abs_delta"]}
+            if not g["bit_exact"]:
+                raise RuntimeError(
+                    "superres low-res consistency broken: projected output "
+                    f"downsamples {g['max_abs_delta']} away from its "
+                    "conditioning input (must be bit-exact)")
             # preview drain: TWO full-bucket draft requests streaming x̂0
             # frames — previews are delivered per finished batch, so the
             # first request's frames arrive while the second batch is still

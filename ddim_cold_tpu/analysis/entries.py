@@ -41,7 +41,7 @@ N = 4      # batch rows for the non-serve entries
 def serve_sweep():
     from ddim_cold_tpu.serve.batching import SamplerConfig
 
-    return [
+    sweep = [
         ("ddim_k500", SamplerConfig(k=K), (4, 8)),
         ("ddim_k500_ci2", SamplerConfig(k=K, cache_interval=2), (4, 8)),
         # adaptive/token caching (ISSUE 8). ONE adaptive threshold value in
@@ -97,6 +97,36 @@ def serve_sweep():
         ("interp_k500_t400",
          SamplerConfig(task="interp", k=K, t_start=400), (4,)),
     ]
+    # sequence-parallel program family (sp_mode/sp_degree — the engine's
+    # (data, seq)-mesh executables). Gated on the PROCESS's device count:
+    # the graftcheck CLI world runs at 1 CPU device (no sp geometry exists
+    # there), the pytest world at 8 via conftest's
+    # --xla_force_host_platform_device_count. The gate is deterministic
+    # within a process, so both J006 worlds see the same sweep and hash
+    # stability is preserved — each world is internally consistent.
+    n_dev = jax.device_count()
+    if n_dev >= 2 and n_dev % 2 == 0:
+        sweep += [
+            # ulysses vs ring at the same geometry must hash distinctly
+            # (all_to_all pair vs ppermute scan inside the shard_map jaxpr)
+            ("ddim_k500_sp2u",
+             SamplerConfig(k=K, sp_mode="ulysses", sp_degree=2), (4, 8)),
+            ("ddim_k500_sp2r",
+             SamplerConfig(k=K, sp_mode="ring", sp_degree=2), (4,)),
+            # static (non-adaptive) caching composes with sp — the carry
+            # rides the same (data, seq) mesh
+            ("ddim_k500_ci2_sp2u",
+             SamplerConfig(k=K, cache_interval=2, sp_mode="ulysses",
+                           sp_degree=2), (4,)),
+        ]
+    if n_dev >= 8 and n_dev % 8 == 0:
+        # TINY's 4 heads do not divide a seq axis of 8: this entry proves
+        # the ulysses→ring fallback traces (and hashes) at the all-local
+        # geometry — distinct from sp2r because the mesh differs
+        sweep.append(
+            ("ddim_k500_sp8u_fallback",
+             SamplerConfig(k=K, sp_mode="ulysses", sp_degree=8), (8,)))
+    return sweep
 
 
 @dataclass
@@ -145,6 +175,35 @@ class Context:
                                      t2)["params"]
         self.qmodel = self.model.clone(quant="xla")
         self.qparams = jax.eval_shape(quant.quantize_params, self.params)
+        self._sp_meshes: dict = {}
+        self._sp_models: dict = {}
+
+    def sp_mesh(self, degree: int):
+        """The (data, seq) mesh for one sp_degree — the same geometry
+        Engine._sp_mesh builds (data-major over every visible device)."""
+        from ddim_cold_tpu.parallel.mesh import make_mesh
+
+        mesh = self._sp_meshes.get(degree)
+        if mesh is None:
+            n = jax.device_count()
+            mesh = make_mesh({"data": n // degree, "seq": degree})
+            self._sp_meshes[degree] = mesh
+        return mesh
+
+    def sp_model(self, config):
+        """The sp model clone a config's programs trace — routed through
+        models.sp_clone, the SAME resolver the engine uses, so the sweep's
+        ulysses→ring fallback can never diverge from serving's."""
+        from ddim_cold_tpu.models.vit import sp_clone
+
+        key = (config.sp_mode, config.sp_degree, config.quant)
+        model = self._sp_models.get(key)
+        if model is None:
+            base = self.qmodel if config.quant else self.model
+            model = self._sp_models[key] = sp_clone(
+                base, self.sp_mesh(config.sp_degree),
+                sp_mode=config.sp_mode)
+        return model
 
     def x(self, n: int):
         H, W = self.model.img_size
@@ -269,6 +328,12 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
     from ddim_cold_tpu.ops import sampling
 
     model = ctx.qmodel if config.quant else ctx.model
+    if config.sp_degree > 1:
+        # the engine traces sp configs against the sp clone over the
+        # per-degree (data, seq) mesh; the mesh appears in the shard_map
+        # jaxpr params, so sp programs hash distinctly from non-sp (and
+        # per-geometry) even though the arg avals are identical
+        model = ctx.sp_model(config)
     params = ctx.qparams if config.quant else ctx.params
     x = ctx.x(bucket)
     seq = config.preview_every > 0

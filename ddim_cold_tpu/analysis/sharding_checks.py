@@ -16,6 +16,11 @@ via ``eval_shape``) and flags:
   ``kernel`` or its ``w_int8`` encoding) whose spec does not mention the
   'model' axis even though the axis set offers it: the Megatron split
   silently degraded to replication.
+* S003 — sequence-parallel ACTIVATION specs (the ``P(batch_axis, seq_axis,
+  head_axis, None)`` family the ulysses/ring attention fronts shard_map
+  over the model's ``seq_mesh``): an sp axis missing from the mesh, an
+  axis double-used across spec dims, or a resolved 'ulysses' mode whose
+  head count does not divide the seq axis (the sp_clone fallback bypassed).
 """
 
 from __future__ import annotations
@@ -102,6 +107,73 @@ def check_param_tree(params, specs, tag: str,
     return findings
 
 
+SP_PATH = "ddim_cold_tpu/parallel/ulysses.py"
+
+
+def check_sp_activation_specs() -> list[Finding]:
+    """GRAFT-S003: every sequence-parallel model the serve sweep traces has
+    a PLACEABLE activation sharding.
+
+    The sp attention fronts (``ulysses_self_attention`` /
+    ``ring_self_attention``) shard the (B, N, H, D) activations with
+    ``P(batch_axis, seq_axis, head_axis, None)`` inside a shard_map over
+    the model's ``seq_mesh`` — patch tokens sequence-sharded, everything
+    else (CLS/time conditioning included) replicated outside the manual
+    region. Walks the sp clones of analysis/entries.py's serve sweep (the
+    same device-count gate, so the CLI world at 1 device simply has no sp
+    geometry to check) and flags: an sp axis name that is not an axis of
+    the mesh (shard_map would raise at warmup), an axis reused across two
+    spec dims (double-sharding), and a RESOLVED 'ulysses' mode whose
+    tp-local head count does not divide the seq axis — the structural
+    requirement the models.sp_clone fallback exists to uphold, so a finding
+    here means the fallback was bypassed."""
+    from ddim_cold_tpu.analysis.entries import Context, serve_sweep
+
+    ctx = Context()
+    findings: list[Finding] = []
+    seen = set()
+    for label, config, _ in serve_sweep():
+        geom = (config.sp_mode, config.sp_degree)
+        if config.sp_degree == 1 or geom in seen:
+            continue
+        seen.add(geom)
+        model = ctx.sp_model(config)
+        mesh_axes = dict(model.seq_mesh.shape)
+        used: list[str] = []
+        for field_name, ax in (("batch_axis", model.batch_axis),
+                               ("seq_axis", model.seq_axis),
+                               ("head_axis", model.head_axis)):
+            if ax is None:
+                continue
+            if ax not in mesh_axes:
+                findings.append(Finding(
+                    "GRAFT-S003", SP_PATH, f"{label}:{field_name}", 0,
+                    f"sp model for {label} names {field_name}={ax!r} but "
+                    f"the seq_mesh axes are {tuple(mesh_axes)} — shard_map "
+                    "would raise at warmup"))
+                continue
+            if ax in used:
+                findings.append(Finding(
+                    "GRAFT-S003", SP_PATH, f"{label}:{field_name}", 0,
+                    f"sp model for {label} reuses mesh axis {ax!r} for "
+                    f"{field_name} and another spec dim — the activation "
+                    "would double-shard over the same devices"))
+                continue
+            used.append(ax)
+        if model.sp_mode == "ulysses":
+            tp = mesh_axes.get(model.head_axis, 1) if model.head_axis else 1
+            s = mesh_axes.get(model.seq_axis, 1)
+            if (model.num_heads // tp) % s:
+                findings.append(Finding(
+                    "GRAFT-S003", SP_PATH, f"{label}:heads", 0,
+                    f"sp model for {label} resolved to 'ulysses' with "
+                    f"{model.num_heads}//{tp} local heads over a seq axis "
+                    f"of {s} — not divisible; the sp_clone ring fallback "
+                    "was bypassed and warmup would raise "
+                    "SeqParallelConfigError"))
+    return findings
+
+
 def _tiny_params(**overrides):
     from ddim_cold_tpu.analysis.entries import TINY
     from ddim_cold_tpu.models import DiffusionViT
@@ -130,4 +202,5 @@ def run_sharding_checks() -> list[Finding]:
     for tag, params in trees.items():
         specs = param_partition_specs(params)
         findings += check_param_tree(params, specs, tag)
+    findings += check_sp_activation_specs()
     return findings

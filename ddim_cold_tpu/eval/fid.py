@@ -384,3 +384,45 @@ def quantized_sampler_guard(
                       "extractor — distance is meaningful, absolute FID "
                       "scale is not)"),
     }
+
+
+def superres_consistency_guard(outputs, low_res) -> dict:
+    """Editing-quality guard for served super-resolution (ROADMAP open
+    item): the delivered output must still CONTAIN its input — nearest-
+    downsampling the output (ops/degrade's floor-index convention, i.e.
+    sampling the static anchor pixels) must reproduce the low-res input
+    bit-exactly, in the engine's [0, 1] delivery space against the task's
+    [−1, 1] input space (``(low_res + 1) / 2``).
+
+    The raw cold scan does not guarantee this (its naive Algorithm-1 update
+    predicts the anchors rather than carrying them), so callers run
+    ``workloads.superres_project`` — the host-side data-consistency
+    projection — on the delivered batch first; the guard then proves the
+    whole convention stack end to end: the nearest-index math, the value
+    mapping, and (served) that every row was projected against ITS OWN
+    request's input — a row swap, a bucket-padding leak, or a resampled
+    index table all break bit-exactness. ``bench.py --edit`` rides this and
+    raises when ``bit_exact`` is False.
+
+    Returns ``{"bit_exact", "max_abs_delta", "anchor_pixels"}`` —
+    ``max_abs_delta`` is also a useful RAW-output quality metric (how far
+    the un-projected sampler drifts from its input), which is why the guard
+    takes arrays instead of running the sampler itself.
+    """
+    from ddim_cold_tpu.data.resize import nearest_indices
+
+    out = np.asarray(outputs, np.float32)
+    low = np.asarray(low_res, np.float32)
+    if out.ndim == 3:
+        out = out[None]
+    if low.ndim == 3:
+        low = low[None]
+    iy = nearest_indices(low.shape[1], out.shape[1])
+    ix = nearest_indices(low.shape[2], out.shape[2])
+    down = out[:, iy[:, None], ix[None, :], :]
+    target = (low + 1.0) / 2.0
+    return {
+        "bit_exact": bool(np.array_equal(down, target)),
+        "max_abs_delta": round(float(np.max(np.abs(down - target))), 6),
+        "anchor_pixels": int(down[0, ..., 0].size),
+    }

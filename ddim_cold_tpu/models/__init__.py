@@ -2,7 +2,9 @@ from ddim_cold_tpu.models.vit import (
     DiffusionViT,
     MODEL_CONFIGS,
     positionalencoding1d,
+    sp_clone,
 )
 from ddim_cold_tpu.models import init
 
-__all__ = ["DiffusionViT", "MODEL_CONFIGS", "positionalencoding1d", "init"]
+__all__ = ["DiffusionViT", "MODEL_CONFIGS", "positionalencoding1d",
+           "sp_clone", "init"]

@@ -874,3 +874,27 @@ class DiffusionViT(nn.Module):
         x = x.reshape(B, H // p, W // p, p, p, C)
         x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, H/p, p, W/p, p, C)
         return x.reshape(B, H, W, C)
+
+
+def sp_clone(model: DiffusionViT, mesh, *, sp_mode: str = "ulysses",
+             seq_axis: str = "seq", batch_axis: str = "data",
+             head_axis=None) -> DiffusionViT:
+    """The sequence-parallel variant of ``model`` for sampling over ``mesh``
+    — the SAME clone the serve engine builds per sp config (engine, direct
+    callers, and the graftcheck sweep all route through here so the
+    strategy resolution can never diverge between them).
+
+    Resolution: ``sp_mode='ulysses'`` needs the tp-local head count
+    divisible by the seq axis (parallel/ulysses.py raises
+    SeqParallelConfigError otherwise), so it falls back to the ring — which
+    has no head constraint — instead of failing at trace time. Patch tokens
+    end up sequence-sharded inside the attention shard_map; the CLS/time
+    conditioning stays replicated like every other non-sequence activation.
+    """
+    parts = int(mesh.shape[seq_axis])
+    tp = int(mesh.shape[head_axis]) if head_axis else 1
+    if sp_mode == "ulysses" and (model.num_heads // tp) % parts:
+        sp_mode = "ring"
+    return model.clone(seq_mesh=mesh, seq_axis=seq_axis,
+                       batch_axis=batch_axis, head_axis=head_axis,
+                       sp_mode=sp_mode)

@@ -321,7 +321,13 @@ def ddim_sample(
     ``return_sequence=True`` returns the (n_steps+1, N, H, W, C) trajectory of
     the initial noise plus every x̂0 prediction (the denoise-sequence figure).
     With a ``mesh``, the batch is sharded over its 'data' axis and the scan
-    runs SPMD across the chips.
+    runs SPMD across the chips. A ``(data, seq)`` mesh additionally runs
+    sequence-parallel attention when ``model`` was cloned onto it
+    (``models.sp_clone`` — the serve engine's ``sp_mode``/``sp_degree``
+    configs are exactly this pairing): the batch stays 'data'-sharded here
+    while the patch tokens shard over 'seq' inside the attention shard_map,
+    so ONE large request can use every chip instead of only scaling with
+    batch. Put ``params`` on the same mesh (``parallel.shard_params``).
 
     ``eta`` interpolates toward stochastic (DDPM-like) sampling per the DDIM
     paper (schedule.ddim_coefficients; beyond-parity, default 0 = the

@@ -8,8 +8,10 @@ from ddim_cold_tpu.parallel.mesh import (
 )
 from ddim_cold_tpu.parallel.pipeline import make_pipelined_apply, pipeline_blocks
 from ddim_cold_tpu.parallel.sharding import param_partition_specs, pipeline_param_specs
+from ddim_cold_tpu.parallel.ulysses import SeqParallelConfigError
 
 __all__ = [
+    "SeqParallelConfigError",
     "make_mesh",
     "batch_sharding",
     "replicated",

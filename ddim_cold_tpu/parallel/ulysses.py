@@ -31,6 +31,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ddim_cold_tpu.parallel._compat import shard_map
 
 
+class SeqParallelConfigError(ValueError):
+    """A sequence-parallel geometry that cannot run: head count vs seq-axis
+    divisibility (Ulysses' structural requirement). Subclasses ValueError so
+    existing callers' error handling keeps working; raised with an actionable
+    message naming the serving config knobs (``SamplerConfig.sp_mode`` /
+    ``sp_degree``) — the engine's ring fallback catches exactly this class
+    when resolving a config's attention strategy."""
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -56,9 +65,11 @@ def ulysses_attention(
     S = jax.lax.psum(1, axis_name)  # static inside shard_map
     B, n_loc, H_loc, D = q.shape
     if H_loc % S != 0:
-        raise ValueError(
+        raise SeqParallelConfigError(
             f"ulysses needs local heads ({H_loc}) divisible by the "
-            f"'{axis_name}' axis ({S}); use sp_mode='ring' otherwise")
+            f"'{axis_name}' axis ({S}); use sp_mode='ring' otherwise "
+            "(serving: SamplerConfig(sp_mode='ring', sp_degree=...), or "
+            "pick an sp_degree that divides the local head count)")
     Np = n_loc * S
     n_valid = Np if n_valid is None else n_valid
     n_pad = Np - n_valid
@@ -136,12 +147,14 @@ def ulysses_self_attention(
             f"{dict(mesh.shape)} — drop it, or add the tp axis to the mesh")
     tp = int(mesh.shape[head_axis]) if head_axis else 1
     if H % tp != 0:
-        raise ValueError(
+        raise SeqParallelConfigError(
             f"num_heads ({H}) must divide over the '{head_axis}' axis ({tp})")
     if (H // tp) % parts != 0:
-        raise ValueError(
+        raise SeqParallelConfigError(
             f"ulysses needs local heads ({H}//{tp}={H // tp}) divisible by "
-            f"the '{axis}' axis ({parts}); use sp_mode='ring' otherwise")
+            f"the '{axis}' axis ({parts}); use sp_mode='ring' otherwise "
+            "(serving: SamplerConfig(sp_mode='ring', sp_degree=...), or "
+            "pick an sp_degree that divides the local head count)")
     n_pad = (-N) % parts
     if n_pad:
         pad = [(0, 0), (0, n_pad), (0, 0), (0, 0)]

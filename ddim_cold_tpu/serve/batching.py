@@ -32,6 +32,7 @@ _QUANT_MODES = (None, "xla", "pallas")  # ops/quant.py QUANT_MODES + off
 #: graftcheck A004 — and the workloads package imports jax); the two tuples
 #: are pinned equal by tests/test_workloads.py
 _TASKS = ("sample", "inpaint", "superres", "draft", "interp")
+_SP_MODES = ("none", "ulysses", "ring")
 
 
 @dataclass(frozen=True)
@@ -69,6 +70,14 @@ class SamplerConfig:
     # every m-th intermediate x̂0 frame via Ticket.previews() — the engine
     # then dispatches the SEQUENCE scan variant (a distinct program, part of
     # the warmed set)
+    sp_mode: str = "none"          # "none" | "ulysses" | "ring": sequence
+    # parallelism for this config's programs. Off by default — the defaults
+    # keep every pre-sp config hash-equal to its old self, so sp_degree=1
+    # dispatches are bitwise the existing serve path by construction.
+    sp_degree: int = 1             # seq-axis size of the (data, seq) mesh
+    # the engine builds for this config (its local device count must divide
+    # by it). Static: part of the program key — sp and non-sp requests never
+    # coalesce, they run differently-sharded programs.
 
     def __post_init__(self):
         if self.sampler not in _SAMPLERS:
@@ -129,6 +138,35 @@ class SamplerConfig:
                 raise ValueError(
                     f"task {self.task!r} decodes from an intermediate noise "
                     "level — t_start= is required")
+        # imported lazily: the sp error type lives with the sp kernels, and
+        # this module must stay import-free of the (jax-importing) parallel
+        # package; any caller constructing a config has serve loaded already
+        from ddim_cold_tpu.parallel.ulysses import SeqParallelConfigError
+        if self.sp_mode not in _SP_MODES:
+            raise SeqParallelConfigError(
+                f"sp_mode must be one of {_SP_MODES}, got {self.sp_mode!r}")
+        if self.sp_degree < 1:
+            raise SeqParallelConfigError(
+                f"sp_degree must be >= 1, got {self.sp_degree}")
+        if self.sp_mode == "none" and self.sp_degree != 1:
+            raise SeqParallelConfigError(
+                f"sp_degree={self.sp_degree} needs a strategy — pass "
+                "sp_mode='ulysses' (head↔sequence all-to-all; local heads "
+                "must divide by sp_degree) or sp_mode='ring' (no head "
+                "constraint)")
+        if self.sp_mode != "none" and self.sp_degree < 2:
+            raise SeqParallelConfigError(
+                f"sp_mode={self.sp_mode!r} shards the sequence over "
+                "sp_degree >= 2 devices — sp_degree=1 has no seq axis; "
+                "drop sp_mode (the default 'none' IS the degree-1 program)")
+        if self.sp_degree > 1 and self.cached and self.cache_mode == "adaptive":
+            raise SeqParallelConfigError(
+                "sequence parallelism cannot compose with the batch-coupled "
+                "adaptive cache: the drift gate's batch-max reduction is not "
+                "psum'd over the seq axis, so the two sequence shards could "
+                "take DIFFERENT refresh branches and desynchronize the "
+                "carry — use cache_mode='delta'/'full'/'token' with sp, or "
+                "sp_degree=1 for adaptive caching")
     @property
     def cached(self) -> bool:
         return self.cache_interval > 1

@@ -61,6 +61,25 @@ than one device; same as training). A quant config keeps the same contract
 against a direct call on the quantized model/params pair
 (``model.clone(quant=...)`` + ``quant.quantize_params(params)`` — the
 deterministic transform the engine itself applies).
+
+**Sequence parallelism.** A config with ``sp_degree > 1`` compiles its
+programs against a per-degree ``(data, seq)`` mesh over the local devices
+(``make_mesh({"data": n_dev // sp_degree, "seq": sp_degree})``) with the
+model cloned to run its attention through ``ulysses_self_attention`` /
+``ring_self_attention`` (patch tokens sequence-sharded inside the
+shard_map, the CLS/time conditioning replicated like every other
+non-sequence activation). The registry key is unchanged — ``(config,
+bucket)`` — because ``sp_mode``/``sp_degree`` are fields of the hashed
+config, so sp and non-sp programs can never collide and never coalesce
+into one batch. ``sp_mode='ulysses'`` falls back to the ring when the
+head count does not divide by the seq axis (Ulysses' structural
+requirement; the ring has none). Contract-wise: the degenerate
+``sp_degree=1`` IS the default config (``SamplerConfig`` rejects
+``sp_mode != 'none'`` at degree 1), so degree-1 dispatches are bitwise
+the existing serve path by identity, not by luck; ``sp_degree > 1``
+output matches the degree-1 program at float tolerance only — the
+seq-axis collectives reduce in a different order, same caveat as the
+data mesh vs one device.
 """
 
 from __future__ import annotations
@@ -76,7 +95,8 @@ import numpy as np
 
 from ddim_cold_tpu.data.loader import device_prefetch
 from ddim_cold_tpu.ops import sampling, step_cache
-from ddim_cold_tpu.parallel.mesh import batch_sharding, data_axis_size, shard_params
+from ddim_cold_tpu.parallel.mesh import (batch_sharding, data_axis_size,
+                                         make_mesh, shard_params)
 from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
                                           Ticket, plan_batches)
 from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
@@ -166,7 +186,16 @@ class Engine:
         self._key0 = jax.random.PRNGKey(0)
         self._programs: dict = {}
         # (bucket, kind) -> recycled step-cache carry; kind per _cache_kind
+        # (sp configs get their own kinds — a carry placed on a (data, seq)
+        # mesh cannot be donated to a program compiled for another mesh)
         self._spare_caches: dict = {}
+        # sequence parallelism: per-degree (data, seq) meshes over this
+        # engine's devices, the sp model clones traced against them, and the
+        # param trees re-placed on them (AOT executables are sharding-strict
+        # — an sp program must see params on ITS mesh, not the engine's)
+        self._sp_meshes: dict = {}   # sp_degree -> Mesh
+        self._sp_models: dict = {}   # (mode, degree, quant) -> model clone
+        self._sp_params: dict = {}   # (degree, quantized?) -> placed tree
         # w8a16 serving (ops/quant.py): the int8 tree is built ONCE from the
         # float params on the first quant config and shipped/pinned like the
         # float tree — every quant dispatch reuses the same device buffers
@@ -326,6 +355,15 @@ class Engine:
         key = (config, bucket)
         prog = self._programs.get(key)
         if prog is None:
+            if config.sp_degree > 1:
+                shards = data_axis_size(self._sp_mesh(config.sp_degree))
+                if bucket % shards:
+                    raise ValueError(
+                        f"bucket {bucket} does not divide the sp config's "
+                        f"data axis ({shards} = {self._n_devices()} devices "
+                        f"/ sp_degree {config.sp_degree}); pick buckets that "
+                        "divide it, or a larger sp_degree (which shrinks the "
+                        "data axis)")
             faults.fire("serve.compile", tag=f"bucket:{bucket}|")
             self._mark(f"compile bucket={bucket}", budget_s=4 * self.stall_s)
             prog = self._build_program(config, bucket)
@@ -333,40 +371,120 @@ class Engine:
             self.stats["compiles"] += 1
         return prog
 
+    # -------------------------------------------------- sequence parallelism
+
+    def _devices(self) -> list:
+        """The devices sp meshes are built over: the engine mesh's devices
+        when one was given (sp subdivides the same hardware), else every
+        local device."""
+        if self.mesh is not None:
+            return list(self.mesh.devices.flat)
+        return jax.local_devices()
+
+    def _n_devices(self) -> int:
+        return len(self._devices())
+
+    def _sp_mesh(self, degree: int):
+        """The (data, seq) mesh for one sp_degree — built once, shared by
+        every config at that degree (data-major, so each seq group is a
+        contiguous ICI neighborhood)."""
+        mesh = self._sp_meshes.get(degree)
+        if mesh is None:
+            devices = self._devices()
+            if len(devices) % degree:
+                raise ValueError(
+                    f"sp_degree={degree} does not divide the "
+                    f"{len(devices)} local device(s) — the (data, seq) mesh "
+                    "needs a whole data axis; pick an sp_degree from the "
+                    "divisors of the device count")
+            mesh = make_mesh({"data": len(devices) // degree, "seq": degree},
+                             devices=np.asarray(devices))
+            self._sp_meshes[degree] = mesh
+        return mesh
+
+    def _mesh_for(self, config: SamplerConfig):
+        """The mesh a config's programs run on: the engine's own mesh for
+        the degree-1 (default) configs — the existing path, untouched — else
+        the per-degree (data, seq) mesh."""
+        if config.sp_degree == 1:
+            return self.mesh
+        return self._sp_mesh(config.sp_degree)
+
+    def _sharding_for(self, config: SamplerConfig):
+        """Batch sharding for a config's inputs, or None off-mesh."""
+        mesh = self._mesh_for(config)
+        return batch_sharding(mesh) if mesh is not None else None
+
+    def _sp_attn_mode(self, config: SamplerConfig) -> str:
+        """Resolve the attention strategy: 'ulysses' needs the head count
+        divisible by the seq axis (it reshards heads<->sequence with
+        all-to-alls — parallel/ulysses.py raises SeqParallelConfigError
+        otherwise), so it falls back to the ring, which has no head
+        constraint, instead of failing the warmup."""
+        if (config.sp_mode == "ulysses"
+                and self.model.num_heads % config.sp_degree):
+            return "ring"
+        return config.sp_mode
+
     def _model_for(self, config: SamplerConfig):
-        """The model variant a config's programs trace: ``quant`` is a field
-        of the (hash-by-value) module, so quant and float programs can never
-        collide in jit/AOT caches."""
-        if not config.quant:
-            return self.model
-        model = self._quant_models.get(config.quant)
+        """The model variant a config's programs trace: ``quant``, the sp
+        mesh, and the sp axis names are all fields of the (hash-by-value)
+        module, so quant/float and sp/non-sp programs can never collide in
+        jit/AOT caches. sp composes with quant: the sp clone starts from the
+        quant clone."""
+        base = self.model
+        if config.quant:
+            base = self._quant_models.get(config.quant)
+            if base is None:
+                base = self._quant_models[config.quant] = self.model.clone(
+                    quant=config.quant)
+        if config.sp_degree == 1:
+            return base
+        key = (config.sp_mode, config.sp_degree, config.quant)
+        model = self._sp_models.get(key)
         if model is None:
-            model = self._quant_models[config.quant] = self.model.clone(
-                quant=config.quant)
+            from ddim_cold_tpu.models.vit import sp_clone
+
+            model = self._sp_models[key] = sp_clone(
+                base, self._sp_mesh(config.sp_degree),
+                sp_mode=config.sp_mode)
         return model
 
     def _params_for(self, config: SamplerConfig):
         if not config.quant:
-            return self.params
-        if self._qparams is None:
-            from ddim_cold_tpu.ops import quant
+            base = self.params
+        else:
+            if self._qparams is None:
+                from ddim_cold_tpu.ops import quant
 
-            qp = quant.quantize_params(self.params)
-            self._qparams = (shard_params(qp, self.mesh)
-                             if self.mesh is not None else qp)
-            self.stats["param_bytes"] = quant.param_bytes(self.params)
-            self.stats["param_bytes_quant"] = quant.param_bytes(self._qparams)
-        return self._qparams
+                qp = quant.quantize_params(self.params)
+                self._qparams = (shard_params(qp, self.mesh)
+                                 if self.mesh is not None else qp)
+                self.stats["param_bytes"] = quant.param_bytes(self.params)
+                self.stats["param_bytes_quant"] = quant.param_bytes(
+                    self._qparams)
+            base = self._qparams
+        if config.sp_degree == 1:
+            return base
+        # re-place (replicated) on the config's (data, seq) mesh, once per
+        # (degree, quantization) — the sp executable rejects params committed
+        # to a different mesh
+        key = (config.sp_degree, bool(config.quant))
+        placed = self._sp_params.get(key)
+        if placed is None:
+            placed = self._sp_params[key] = shard_params(
+                base, self._sp_mesh(config.sp_degree))
+        return placed
 
-    def _x_struct(self, bucket: int):
+    def _x_struct(self, bucket: int, config: SamplerConfig):
         H, W = self.model.img_size
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         return jax.ShapeDtypeStruct((bucket, H, W, self.model.in_chans),
-                                    jnp.float32, sharding=sharding)
+                                    jnp.float32,
+                                    sharding=self._sharding_for(config))
 
     def _cache_struct(self, bucket: int, config: SamplerConfig):
         shape = (bucket, self.model.num_patches + 1, self.model.embed_dim)
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        sharding = self._sharding_for(config)
         s = jax.ShapeDtypeStruct(shape, self.model.dtype, sharding=sharding)
         if config.cache_mode == "adaptive":
             # the drift gate's reference image rides the carry (f32,
@@ -378,11 +496,10 @@ class Engine:
             return (s, s, x_ref)
         return (s, s)
 
-    def _mask_struct(self, bucket: int):
+    def _mask_struct(self, bucket: int, config: SamplerConfig):
         H, W = self.model.img_size
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         return jax.ShapeDtypeStruct((bucket, H, W, 1), jnp.float32,
-                                    sharding=sharding)
+                                    sharding=self._sharding_for(config))
 
     def _build_program(self, config: SamplerConfig, bucket: int):
         """AOT-compile the scan for this (config, bucket): trace with shape
@@ -397,18 +514,20 @@ class Engine:
         its own constrained scan; the other tasks reuse the plain programs
         (their task-ness lives entirely in the init, so e.g. draft and
         guided-sample configs with equal fields share an executable)."""
-        x = self._x_struct(bucket)
+        x = self._x_struct(bucket, config)
         model, params = self._model_for(config), self._params_for(config)
         seq = config.preview_every > 0
         if config.task == "inpaint":
             if config.cached:
                 return _inpaint_cached_lower(
-                    model, params, x, self._mask_struct(bucket), self._key0,
-                    self._cache_struct(bucket, config), config, seq)
+                    model, params, x, self._mask_struct(bucket, config),
+                    self._key0, self._cache_struct(bucket, config), config,
+                    seq)
             fn = (sampling._ddim_scan_inpaint_seq if seq
                   else sampling._ddim_scan_inpaint)
             return fn.lower(
-                model, params, x, x, self._mask_struct(bucket), self._key0,
+                model, params, x, x, self._mask_struct(bucket, config),
+                self._key0,
                 k=config.k, t_start=config.t_start, eta=0.0,
                 sequence=seq).compile()
         if config.sampler == "cold":
@@ -507,8 +626,9 @@ class Engine:
         if plan.padded_rows:
             parts.append(_pad(parts))
         x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
-        if self.mesh is not None:
-            x = jax.device_put(x, batch_sharding(self.mesh))
+        sharding = self._sharding_for(plan.config)
+        if sharding is not None:
+            x = jax.device_put(x, sharding)
         xs = [x]
         for name in _EXTRA_INPUTS.get(plan.config.task, ()):
             cols = [jnp.asarray(req.extras[name][lo:hi], jnp.float32)
@@ -516,8 +636,8 @@ class Engine:
             if plan.padded_rows:
                 cols.append(_pad(cols))
             e = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=0)
-            if self.mesh is not None:
-                e = jax.device_put(e, batch_sharding(self.mesh))
+            if sharding is not None:
+                e = jax.device_put(e, sharding)
             xs.append(e)
         return plan, tuple(xs)
 
@@ -534,13 +654,18 @@ class Engine:
 
     # ------------------------------------------------------------- dispatch
 
-    def _cache_kind(self, config: SamplerConfig) -> str:
+    def _cache_kind(self, config: SamplerConfig):
         """Spare-cache pool key suffix: delta/full/token all share the
         two-leaf (B, N+1, E) carry structure ("pair" — a recycled carry is
         interchangeable between them because every schedule's step 0
         refreshes before reading), while adaptive's third x_ref leaf needs
-        its own pool."""
-        return "adaptive" if config.cache_mode == "adaptive" else "pair"
+        its own pool. sp configs extend the key with their (mode, degree)
+        identity: a carry committed to one mesh cannot be donated to a
+        program compiled for another."""
+        kind = "adaptive" if config.cache_mode == "adaptive" else "pair"
+        if config.sp_degree > 1:
+            return (kind, config.sp_mode, config.sp_degree)
+        return kind
 
     def _take_cache(self, bucket: int, config: SamplerConfig):
         cache = self._spare_caches.pop((bucket, self._cache_kind(config)),
@@ -553,12 +678,25 @@ class Engine:
                                           mode=config.cache_mode,
                                           img_shape=(H, W,
                                                      self.model.in_chans))
-            cache = step_cache.shard_cache(cache, self.mesh)
+            cache = step_cache.shard_cache(cache, self._mesh_for(config))
         return cache
 
     def _recycle_cache(self, bucket: int, config: SamplerConfig,
                        cache_out) -> None:
         self._spare_caches[(bucket, self._cache_kind(config))] = cache_out
+
+    def prewarm_cache(self, config: SamplerConfig, bucket: int) -> None:
+        """Pre-allocate the spare step-cache carry for a cached (config,
+        bucket) on the config's mesh — warmup calls this next to
+        ``ensure_program`` so the first cached dispatch donates a pool-owned
+        buffer instead of paying the allocation inline (sp configs get their
+        per-mesh carries prebuilt the same way; no-op when the pool already
+        holds a compatible carry)."""
+        if not config.cached:
+            return
+        key = (bucket, self._cache_kind(config))
+        if key not in self._spare_caches:
+            self._spare_caches[key] = self._take_cache(bucket, config)
 
     def _dispatch(self, plan: BatchPlan, xs):
         prog = self.ensure_program(plan.config, plan.bucket)

@@ -37,6 +37,16 @@ router makes the replica the blast radius instead of the fleet:
   share (``QueueFullError``) while others keep theirs. Within the control
   loop, placement is weighted round-robin over per-tenant priority queues.
 
+* **Sequence-parallel placement** — configs with ``sp_degree > 1``
+  (serve/engine.py's (data, seq)-mesh programs) route exactly like any
+  other config: every replica warms the SAME ``(SamplerConfig, bucket)``
+  set, so each replica owns the per-degree meshes, sp model clones, and
+  re-placed param trees for every sp config the deployment serves, and an
+  sp ticket fails over to a survivor — or to a freshly spawned
+  replacement — without a serve-time compile or param placement. The
+  router never inspects the mesh: sp-ness is static config identity, and
+  the placement/hedging/failover invariants above are sharding-blind.
+
 Liveness contract (same as the engine's): no admitted ticket blocks
 forever — every path ends in delivery or a typed failure naming the
 replica it happened on.
@@ -174,6 +184,8 @@ class Router:
             self._next_rep += 1
         faults.fire("replica.spawn", tag=f"replica:{rid}|")
         rep = self._factory(rid)
+        # the FULL config set, sp included — a replacement replica that
+        # skipped an sp config would compile at its first failover ticket
         rep.warm(self._configs, self._buckets, **self._warm_kwargs)
         rep.start()
         with self._lock:

@@ -43,6 +43,16 @@ def warmup(engine, configs: Sequence[SamplerConfig],
     compile fails (degraded startup beats no startup: a config whose compile
     is broken will fail at its own dispatch, not take the deployment down);
     the per-program exceptions land in ``report["errors"]``.
+
+    Sequence-parallel configs (``sp_degree > 1``) warm like any other: the
+    first ``ensure_program`` that needs a degree builds its (data, seq)
+    mesh, the sp model clone, AND the param tree re-placed on that mesh, so
+    a warmed engine serves sp requests with zero serve-time compiles and
+    zero serve-time param placements. Cached configs additionally get their
+    spare step-cache carry pre-allocated on the config's mesh
+    (:meth:`Engine.prewarm_cache`), so the first dispatch donates a
+    pool-owned buffer instead of paying the allocation inline. The report's
+    ``sp_meshes`` lists the geometries built (``{degree: {axis: size}}``).
     """
     buckets = tuple(buckets) if buckets is not None else engine.buckets
     active_dir = enable_compile_cache(cache_dir) if persistent_cache else None
@@ -52,6 +62,8 @@ def warmup(engine, configs: Sequence[SamplerConfig],
         for bucket in buckets:
             try:
                 engine.ensure_program(config, bucket)
+                if config.cached:
+                    engine.prewarm_cache(config, bucket)
             except Exception as exc:  # noqa: BLE001 — optionally isolated
                 if not tolerate_errors:
                     raise
@@ -62,5 +74,7 @@ def warmup(engine, configs: Sequence[SamplerConfig],
         "buckets": buckets,
         "configs": len(set(configs)),
         "cache_dir": active_dir,
+        "sp_meshes": {d: dict(m.shape)
+                      for d, m in getattr(engine, "_sp_meshes", {}).items()},
         "errors": errors,
     }

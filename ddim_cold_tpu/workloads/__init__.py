@@ -38,10 +38,11 @@ from ddim_cold_tpu.workloads.tasks import (EDIT_TASKS, TASKS,
                                            draft_to_drawing, inpaint,
                                            interp_init, interpolate,
                                            normalize_mask, super_resolve,
-                                           superres_init)
+                                           superres_init, superres_project)
 
 __all__ = [
     "EDIT_TASKS", "TASKS", "default_edit_configs", "draft_init",
     "draft_to_drawing", "inpaint", "interp_init", "interpolate",
     "normalize_mask", "preview_indices", "super_resolve", "superres_init",
+    "superres_project",
 ]

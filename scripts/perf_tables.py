@@ -209,6 +209,28 @@ def render(path: str) -> str:
                 f"({pv.get('first_frame_fraction')}× wall) · "
                 f"{pv.get('frames')} frames")
 
+    pl = sub.get("parallel")
+    if pl and not pl.get("skipped"):
+        degs = pl.get("degrees", {})
+        lines.append("")
+        lines.append(
+            "**sequence-parallel serving (single request, "
+            f"bucket={pl.get('bucket')}, {pl.get('devices')} devices):** "
+            + " · ".join(
+                f"sp{d}={leg.get('latency_s')}s"
+                + (f" ({leg.get('speedup_vs_sp1')}× sp1, "
+                   f"{leg.get('sp_mode')})" if d != "1" else "")
+                for d, leg in degs.items())
+            + f" · sp1 bitwise {pl.get('sp1_bitwise_vs_direct')} · "
+              f"compiles after warmup {pl.get('compiles_after_warmup')}")
+        ns_sp = pl.get("northstar_200px_sp")
+        if ns_sp:
+            lines.append(
+                f"200px k=20 all-local sp{ns_sp.get('sp_degree')}: "
+                f"{ns_sp.get('latency_s')}s / {ns_sp.get('img_per_sec')} "
+                f"img/s (bucket {ns_sp.get('bucket')}) · compiles after "
+                f"warmup {ns_sp.get('compiles_after_warmup')}")
+
     for key, label in (("cached_quality_64px", "cached quality 64px"),
                        ("quant_quality_64px", "w8a16 quality 64px"),
                        ("quant_cached_quality_64px",

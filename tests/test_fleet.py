@@ -410,3 +410,25 @@ def test_wedge_detection_from_snapshot():
         time.sleep(0.02)
     assert reps["r0"].drained
     router.drain(timeout=2)
+
+
+def test_sp_ticket_failover_reuses_warmed_programs(model_and_params):
+    """Sequence-parallel placement contract (the sp tentpole at fleet
+    scope): every replica warms the SAME config set, sp included, so an sp
+    ticket hedged off a faulted replica lands on a peer whose (data, seq)
+    program is already compiled — allclose to direct (the mesh tolerance)
+    with zero compiles after warmup anywhere."""
+    model, params = model_and_params
+    sp_cfg = serve.SamplerConfig(k=K, sp_mode="ulysses", sp_degree=2)
+    router = _router(model_and_params, replicas=2, configs=[CFG, sp_cfg])
+    spec = FaultSpec("serve.assemble", "transient", rate=1.0,
+                     match="replica:r0|", max_fires=1)
+    with faults.inject(spec) as plan:
+        t = router.submit(seed=91, n=4, config=sp_cfg)
+        got = t.result(timeout=60)
+    np.testing.assert_allclose(
+        got, _direct(model, params, 91, 4), rtol=2e-5, atol=2e-5)
+    assert len(plan.realized) == 1
+    assert router.stats["hedges"] == 1
+    h = router.drain(timeout=10)
+    assert h["compiles_after_warmup"] == 0

@@ -87,6 +87,29 @@ def test_two_process_pipeline_moe_step(tmp_path):
     assert 0.0 < losses[0] < 10.0
 
 
+def test_two_process_sequence_parallel_sampling(tmp_path):
+    """The serving tentpole's (data, seq) mesh under REAL DCN processes:
+    {seq:2, data:4} over 2 processes × 4 virtual devices puts the ulysses
+    all-to-alls ACROSS the process boundary while each host keeps the batch
+    data-sharded among its own devices. The k-step sp sampler must match
+    the dense local reference at float tolerance (asserted in-worker) and
+    produce ONE identical global-mean digest on every process."""
+    import pytest
+
+    try:
+        digests = _spawn_workers(tmp_path, n_procs=2, local_devices=4,
+                                 mode="spsample", timeout=600)
+    except AssertionError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # some jaxlib CPU builds rendezvous over DCN but cannot EXECUTE
+            # a cross-process program (the same wall every mode in this
+            # harness hits there) — nothing sp-specific to learn, skip
+            pytest.skip("jaxlib CPU backend lacks multiprocess execution")
+        raise
+    assert len(set(digests)) == 1, digests
+    assert 0.0 <= digests[0] <= 1.0  # the sampler delivers in [0, 1]
+
+
 def test_two_process_distributed_train_step(tmp_path):
     losses = _spawn_workers(tmp_path, n_procs=2, local_devices=4, mode="dp",
                             timeout=240)

@@ -622,3 +622,121 @@ def test_replica_id_in_failure_messages_and_fault_tags(model_and_params):
     t2 = eng.submit(seed=481, n=1, config=cfg)
     eng.drain(timeout=1)
     assert "replica 'r9'" in str(t2.exception(timeout=5))
+
+
+# ------------------------------------------------------ sequence parallelism
+
+
+SP2 = serve.SamplerConfig(k=K, sp_mode="ulysses", sp_degree=2)
+
+
+def test_sp_config_validation():
+    """The sp fields are validated at CONSTRUCTION (satellite of the sp
+    tentpole): mode domain, degree floor, the none⟺degree-1 identity in
+    both directions, and the sp × batch-coupled-adaptive rejection — each
+    error names the knob to change and is the typed
+    parallel.SeqParallelConfigError (a ValueError, so untyped callers
+    still catch it)."""
+    from ddim_cold_tpu.parallel import SeqParallelConfigError
+    with pytest.raises(SeqParallelConfigError, match="sp_mode"):
+        serve.SamplerConfig(k=K, sp_mode="megatron")
+    with pytest.raises(SeqParallelConfigError, match="sp_degree"):
+        serve.SamplerConfig(k=K, sp_degree=0)
+    with pytest.raises(SeqParallelConfigError, match="sp_mode='ulysses'"):
+        serve.SamplerConfig(k=K, sp_degree=2)  # a degree needs a strategy
+    with pytest.raises(SeqParallelConfigError, match="sp_degree >= 2"):
+        serve.SamplerConfig(k=K, sp_mode="ulysses")  # a strategy, a degree
+    with pytest.raises(SeqParallelConfigError, match="adaptive"):
+        serve.SamplerConfig(k=K, sp_mode="ring", sp_degree=2,
+                            cache_interval=2, cache_mode="adaptive",
+                            cache_threshold=0.05)
+
+
+def test_sp_degenerate_degree1_is_default_config():
+    """sp_degree=1 IS the existing program: the config carries no sp state
+    (sp_mode='none' is the only legal degree-1 spelling), so it hashes and
+    compares equal to the pre-sp default — bitwise-vs-existing is identity
+    at the registry key, not a float comparison."""
+    assert serve.SamplerConfig(k=K, sp_mode="none", sp_degree=1) == \
+        serve.SamplerConfig(k=K)
+    assert hash(serve.SamplerConfig(k=K, sp_mode="none", sp_degree=1)) == \
+        hash(serve.SamplerConfig(k=K))
+
+
+@pytest.mark.skipif(jax.device_count() % 2 != 0,
+                    reason="sp_degree=2 needs an even device count")
+def test_sp_serving_allclose_both_buckets(model_and_params):
+    """sp_degree=2 serves at BOTH warmed buckets with zero compiles after
+    warmup; rows are allclose to direct sampling — the mesh tolerance (a
+    sharded reduction orders differently), not the bitwise contract."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4, 8))
+    wu = serve.warmup(eng, [SP2], persistent_cache=False)
+    assert wu["new_compiles"] == 2  # one sp program per bucket
+    compiles = eng.stats["compiles"]
+    tickets = {seed: eng.submit(seed=seed, n=n, config=SP2)
+               for seed, n in [(61, 8), (62, 4)]}
+    report = eng.run()
+    assert report["batches"] == 2
+    assert eng.stats["compiles"] == compiles  # zero compiles after warmup
+    for seed, n in [(61, 8), (62, 4)]:
+        got = tickets[seed].result(timeout=5)
+        assert got.shape == (n, 16, 16, 3)
+        np.testing.assert_allclose(got, _direct(model, params, seed, n),
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.skipif(jax.device_count() % 8 != 0,
+                    reason="sp_degree=8 needs a multiple of 8 devices")
+def test_sp_ring_fallback_serves(model_and_params):
+    """sp_degree=8 with 4 heads cannot run Ulysses (4 % 8 != 0): the engine
+    resolves the model through models.sp_clone — the ONE resolver shared
+    with the analysis sweep — and serves the config as ring, transparently
+    to the caller, at the same float tolerance."""
+    model, params = model_and_params
+    cfg = serve.SamplerConfig(k=K, sp_mode="ulysses", sp_degree=8)
+    eng = serve.Engine(model, params, buckets=(4,))
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    assert eng._model_for(cfg).sp_mode == "ring"
+    compiles = eng.stats["compiles"]
+    t = eng.submit(seed=71, n=4, config=cfg)
+    eng.run()
+    assert eng.stats["compiles"] == compiles
+    np.testing.assert_allclose(t.result(timeout=5),
+                               _direct(model, params, 71, 4),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.skipif(jax.device_count() != 8,
+                    reason="pins the 8-device data-axis arithmetic")
+def test_sp_bucket_must_divide_data_axis(model_and_params):
+    """bucket 2 cannot tile sp_degree=2's data axis (8 devices → data=4):
+    the engine refuses at ensure_program with an actionable error instead
+    of letting a mis-tiled batch reach placement."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(2, 4))
+    with pytest.raises(ValueError, match="data axis"):
+        eng.ensure_program(SP2, 2)
+
+
+@pytest.mark.skipif(jax.device_count() % 2 != 0,
+                    reason="sp_degree=2 needs an even device count")
+def test_sp_cached_config_prewarms_spare_pool(model_and_params):
+    """A cached sp config warms its program AND a spare step-cache carry
+    keyed by (bucket, (kind, sp_mode, sp_degree)) — a carry placed on one
+    mesh can never be donated to a program compiled for another — and the
+    drain itself is allclose with zero compiles."""
+    model, params = model_and_params
+    cfg = serve.SamplerConfig(k=K, cache_interval=2, cache_mode="full",
+                              sp_mode="ulysses", sp_degree=2)
+    eng = serve.Engine(model, params, buckets=(4,))
+    serve.warmup(eng, [cfg], persistent_cache=False)
+    assert (4, ("pair", "ulysses", 2)) in eng._spare_caches
+    compiles = eng.stats["compiles"]
+    t = eng.submit(seed=81, n=4, config=cfg)
+    eng.run()
+    assert eng.stats["compiles"] == compiles
+    np.testing.assert_allclose(
+        t.result(timeout=5),
+        _direct(model, params, 81, 4, cache_interval=2, cache_mode="full"),
+        rtol=2e-5, atol=2e-5)

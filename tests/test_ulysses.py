@@ -168,3 +168,38 @@ def test_model_sp_mode_ulysses_composes_with_tp():
     out_sp = jax.jit(sp.apply)({"params": params}, x, t)
     np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_base),
                                rtol=2e-4, atol=2e-5)
+
+def test_divisibility_error_is_typed_and_actionable():
+    """The head-divisibility guard raises SeqParallelConfigError (still a
+    ValueError for old callers) and the message names the serving knobs —
+    the error a misconfigured SamplerConfig surfaces at warmup must say
+    which field to change, not just which reshape failed."""
+    from ddim_cold_tpu.parallel import SeqParallelConfigError
+
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(8, 1, 16, 4, 8)  # 4 heads over 8 shards
+    with pytest.raises(SeqParallelConfigError) as ei:
+        ulysses_self_attention(q, k, v, mesh)
+    assert isinstance(ei.value, ValueError)
+    msg = str(ei.value)
+    assert "sp_mode='ring'" in msg and "sp_degree" in msg
+
+
+def test_sp_clone_resolves_ulysses_with_ring_fallback():
+    """models.sp_clone is THE resolver every caller routes through (engine,
+    analysis sweep, direct use): 'ulysses' survives when the tp-local head
+    count divides the seq axis and falls back to 'ring' otherwise, so
+    serving and static analysis can never resolve differently."""
+    from ddim_cold_tpu.models import sp_clone
+
+    cfg = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
+               num_heads=4)
+    base = DiffusionViT(**cfg)
+    ok = sp_clone(base, make_mesh({"data": 4, "seq": 2}), sp_mode="ulysses")
+    assert ok.sp_mode == "ulysses" and ok.seq_mesh is not None
+    assert ok.seq_axis == "seq" and ok.batch_axis == "data"
+    fb = sp_clone(base, make_mesh({"data": 1, "seq": 8}), sp_mode="ulysses")
+    assert fb.sp_mode == "ring"  # 4 % 8 — the ring has no head constraint
+    tp = sp_clone(base, make_mesh({"model": 2, "seq": 4}),
+                  sp_mode="ulysses", head_axis="model")
+    assert tp.sp_mode == "ring"  # LOCAL heads 4//2 = 2, and 2 % 4 != 0
