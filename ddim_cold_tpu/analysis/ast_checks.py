@@ -1,4 +1,4 @@
-"""AST lint — the repo-specific host-code rules (GRAFT-A001..A004).
+"""AST lint — the repo-specific host-code rules (GRAFT-A001..A005).
 
 Pure ``ast`` walking, no imports of the checked modules, so the lint runs on
 any tree state (including one that currently fails to import). The one
@@ -57,7 +57,16 @@ _DEVICE_MODULES = ("jax.numpy", "jax")
 #: routing tick forces a device sync
 HOST_ONLY_MODULES = ("ddim_cold_tpu/serve/batching.py",
                      "ddim_cold_tpu/serve/fleet.py",
-                     "ddim_cold_tpu/serve/router.py")
+                     "ddim_cold_tpu/serve/router.py",
+                     # the obs layer rides the router's host threads (and its
+                     # registry/span emits sit on serving hot paths) — a jax
+                     # attribute here is a hidden device sync per emit
+                     "ddim_cold_tpu/obs/metrics.py",
+                     "ddim_cold_tpu/obs/spans.py",
+                     "ddim_cold_tpu/obs/device.py")
+
+#: obs.metrics emit methods (rule A005) → the registry kind they imply
+_METRIC_EMITS = ("inc", "gauge", "observe")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -343,6 +352,64 @@ def _check_fault_sites(tree, rel: str, sites: Sequence[str],
     return out
 
 
+def _metric_calls(tree) -> list[tuple[ast.Call, object, object]]:
+    """Every ``<scope>.inc/.gauge/.observe(...)`` emit → (node, name_arg,
+    key_arg). Attribute calls only — a bare ``inc(...)`` is some other
+    function, exactly as ``fire`` detection works in :func:`_fire_calls`."""
+    calls = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func) or ""
+        if name.split(".")[-1] not in _METRIC_EMITS or "." not in name:
+            continue
+        metric = node.args[0] if node.args else None
+        key = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                metric = kw.value
+            elif kw.arg == "key":
+                key = kw.value
+        calls.append((node, metric, key))
+    return calls
+
+
+def _check_metric_sites(tree, rel: str, metric_names: Sequence[str],
+                        seen_pairs: dict) -> list[Finding]:
+    out = []
+    for node, metric, key in _metric_calls(tree):
+        if not isinstance(metric, ast.Constant) or not isinstance(
+                metric.value, str):
+            out.append(Finding(
+                "GRAFT-A005", rel, "metric:<dynamic>", node.lineno,
+                "obs.metrics emit (.inc/.gauge/.observe) must pass a "
+                "string-literal metric name so the registry stays "
+                "statically auditable"))
+            continue
+        name = metric.value
+        if name not in metric_names:
+            out.append(Finding(
+                "GRAFT-A005", rel, f"metric:{name}", node.lineno,
+                f"metric {name!r} is not registered in obs.metrics.METRICS "
+                "— the registry would reject the emit at runtime"))
+        key_lit = (key.value if isinstance(key, ast.Constant)
+                   and isinstance(key.value, str) else None)
+        if key is not None and key_lit is None:
+            continue  # dynamic key= subdivides one site — uniqueness holds
+        pair = (name, key_lit)
+        if pair in seen_pairs:
+            first = seen_pairs[pair]
+            subj = f"metric:{name}" + (f":{key_lit}" if key_lit else "")
+            out.append(Finding(
+                "GRAFT-A005", rel, subj, node.lineno,
+                f"duplicate emit site for metric ({name!r}, key "
+                f"{key_lit!r}) — first emitted at {first}; give the second "
+                "site a distinct literal key= (the A003 tag rule)"))
+        else:
+            seen_pairs[pair] = f"{rel}:{node.lineno}"
+    return out
+
+
 def _check_host_only(tree, rel: str, aliases) -> list[Finding]:
     out = []
     seen = set()
@@ -370,8 +437,10 @@ def _check_host_only(tree, rel: str, aliases) -> list[Finding]:
 # ---------------------------------------------------------------------------
 
 def lint_source(source: str, rel: str, *, sites: Sequence[str] = (),
+                metric_names: Sequence[str] = (),
                 host_only: bool = False,
-                seen_fire_pairs: Optional[dict] = None) -> list[Finding]:
+                seen_fire_pairs: Optional[dict] = None,
+                seen_metric_pairs: Optional[dict] = None) -> list[Finding]:
     """Lint one file's source (the unit tests feed violating snippets here).
     ``rel`` is the repo-relative path used in findings."""
     tree = ast.parse(source)
@@ -383,15 +452,20 @@ def lint_source(source: str, rel: str, *, sites: Sequence[str] = (),
     findings += _check_fault_sites(tree, rel, sites,
                                    {} if seen_fire_pairs is None
                                    else seen_fire_pairs)
+    findings += _check_metric_sites(tree, rel, metric_names,
+                                    {} if seen_metric_pairs is None
+                                    else seen_metric_pairs)
     if host_only:
         findings += _check_host_only(tree, rel, aliases)
     return findings
 
 
 def lint_tree(root: str, package: str = "ddim_cold_tpu",
-              sites: Optional[Sequence[str]] = None) -> list[Finding]:
+              sites: Optional[Sequence[str]] = None,
+              metric_names: Optional[Sequence[str]] = None) -> list[Finding]:
     """Lint every ``.py`` file under ``root/package``. ``sites`` defaults to
-    the live ``utils.faults.SITES`` registry."""
+    the live ``utils.faults.SITES`` registry, ``metric_names`` to the live
+    ``obs.metrics.METRICS`` registry."""
     if sites is None:
         from ddim_cold_tpu.utils import faults
 
@@ -402,8 +476,21 @@ def lint_tree(root: str, package: str = "ddim_cold_tpu",
                             f"SITES:{s}", 0,
                             f"site {s!r} registered more than once in SITES")
                     for s in sorted(dupes)]
+    if metric_names is None:
+        from ddim_cold_tpu.obs import metrics as obs_metrics
+
+        metric_names = tuple(n for n, _, _ in obs_metrics.METRICS)
+        dupes = {n for n in metric_names
+                 if list(metric_names).count(n) > 1}
+        if dupes:
+            return [Finding("GRAFT-A005", f"{package}/obs/metrics.py",
+                            f"METRICS:{n}", 0,
+                            f"metric {n!r} registered more than once in "
+                            "METRICS")
+                    for n in sorted(dupes)]
     findings: list[Finding] = []
     seen_fire: dict = {}
+    seen_metric: dict = {}
     base = os.path.join(root, package)
     for dirpath, _, files in sorted(os.walk(base)):
         for fname in sorted(files):
@@ -414,7 +501,8 @@ def lint_tree(root: str, package: str = "ddim_cold_tpu",
             with open(path) as f:
                 src = f.read()
             findings += lint_source(
-                src, rel, sites=sites,
+                src, rel, sites=sites, metric_names=metric_names,
                 host_only=rel in HOST_ONLY_MODULES,
-                seen_fire_pairs=seen_fire)
+                seen_fire_pairs=seen_fire,
+                seen_metric_pairs=seen_metric)
     return findings

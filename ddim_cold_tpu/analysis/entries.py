@@ -54,6 +54,14 @@ def serve_sweep():
         ("ddim_k500_adapt_qxla",
          SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
                        cache_threshold=0.05, quant="xla"), (4,)),
+        # device-telemetry variants (ISSUE 11): same cached samplers with a
+        # per-step (branch, drift) aux — the extra scan outputs make them
+        # structurally distinct from their plain counterparts
+        ("ddim_k500_ci2_tel",
+         SamplerConfig(k=K, cache_interval=2, telemetry=True), (4,)),
+        ("ddim_k500_adapt_tel",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
+                       cache_threshold=0.05, telemetry=True), (4,)),
         ("ddim_k500_tok3",
          SamplerConfig(k=K, cache_interval=2, cache_mode="token",
                        cache_tokens=3), (4, 8)),
@@ -249,6 +257,10 @@ def build_entries(ctx: Context) -> list[Entry]:
               (p, x, key, ctx.cache(N, "adaptive")), (m,),
               dict(ddim_kw, cache_interval=2, cache_mode="adaptive",
                    cache_threshold=0.05, sequence=False), donates=True),
+        Entry("ddim_scan_cached_tel", SAMP, sampling._ddim_scan_cached_tel,
+              (p, x, key, ctx.cache(N, "adaptive")), (m,),
+              dict(ddim_kw, cache_interval=2, cache_mode="adaptive",
+                   cache_threshold=0.05), donates=True),
         Entry("ddim_scan_cached_token", SAMP, sampling._ddim_scan_cached,
               (p, x, key, ctx.cache(N, "token")), (m,),
               dict(ddim_kw, cache_interval=2, cache_mode="token",
@@ -371,6 +383,14 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
         return Entry("serve", "", fn, (params, x), (model,),
                      dict(levels=config.levels, return_sequence=seq))
     if config.cached:
+        if config.telemetry:
+            # mirrors Engine._ddim_cached_tel_lower: the telemetry scan has
+            # no `sequence` static (last-only by contract)
+            return Entry("serve", "", sampling._ddim_scan_cached_tel,
+                         (params, x, ctx.key,
+                          ctx.cache(bucket, config.cache_mode)), (model,),
+                         dict(k=config.k, t_start=config.t_start, eta=0.0,
+                              **cache_kw))
         fn = (sampling._ddim_scan_cached_seq if seq
               else sampling._ddim_scan_cached)
         return Entry("serve", "", fn,

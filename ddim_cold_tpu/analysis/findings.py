@@ -51,6 +51,8 @@ RULES = {
                   "non-literal site, or duplicate (site, tag) pair",
     "GRAFT-A004": "device-array (jnp/jax) call in a host-only serve module — "
                   "would force a device sync inside row planning",
+    "GRAFT-A005": "obs.metrics emit violation: unregistered metric name, "
+                  "non-literal name, or duplicate (name, key) emit site",
     "GRAFT-S001": "trunk GEMM param leaf (qkv/proj/fc1/fc2 kernel|w_int8) "
                   "fell through to a replicated spec on a model-axis mesh",
     "GRAFT-S002": "param leaf without a usable PartitionSpec (structure "

@@ -1,0 +1,21 @@
+"""Observability: per-request trace spans, the process metrics registry,
+and on-device step telemetry decoding.
+
+* :mod:`ddim_cold_tpu.obs.spans` — trace contexts created at
+  ``Router.submit`` / ``Engine.submit``, propagated plan → assemble →
+  dispatch → fetch → preview → finish and across hedges/failovers;
+  exported as Chrome trace-event JSON (``scripts/obs_report.py``).
+* :mod:`ddim_cold_tpu.obs.metrics` — named counters/gauges/histograms the
+  serving layers emit into; ``Engine.health()`` / ``Router.health()`` are
+  rendered from it.
+* :mod:`ddim_cold_tpu.obs.device` — static-shaped sampler-scan aux
+  (adaptive-gate decisions, drift) decoded into per-ticket summaries.
+
+``spans`` and ``metrics`` are host-only (jax-free, graftcheck A004);
+``device`` imports jax lazily, so ``import ddim_cold_tpu.obs`` is cheap
+anywhere the router/fleet layer runs.
+"""
+
+from ddim_cold_tpu.obs import device, metrics, spans
+
+__all__ = ["device", "metrics", "spans"]

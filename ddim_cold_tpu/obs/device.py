@@ -1,0 +1,82 @@
+"""On-device step telemetry — decode the sampler scans' aux output.
+
+Telemetry-enabled cached samplers (``SamplerConfig(telemetry=True)`` /
+``ddim_sample(..., telemetry=True)``) emit a static-shaped aux alongside
+the images: per scan step, the cache branch **actually taken** (after the
+adaptive drift gate's data-dependent promotion, when the mode is adaptive)
+and the gate's drift value. The aux rides the same compiled ``lax.scan``
+as the images — same program, same zero-compiles-after-warmup contract —
+so cache efficacy is observable per request with no extra dispatches.
+
+This module is the host side: shapes/meaning of the aux and the summary
+dict the engine attaches to tickets. It is deliberately numpy-only at
+import time (the jax side lives in ``ops/sampling.py`` /
+``ops/step_cache.py``); the schedule constants are imported lazily so
+``obs`` stays importable without a jax backend.
+
+Aux layout (``StepTelemetry``): ``branch`` — int32 ``(n_steps,)`` branch
+index per step (0 = refresh, see ``ops/schedule.py:139``); ``drift`` —
+float32 ``(n_steps,)`` batch-max relative drift the adaptive gate computed
+(0 for non-adaptive modes, which never compute a drift).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class StepTelemetry(NamedTuple):
+    """The sampler scan's stacked per-step aux (device or host arrays)."""
+
+    branch: "np.ndarray"  # (n_steps,) int32 — branch taken, post-gate
+    drift: "np.ndarray"   # (n_steps,) float32 — adaptive drift (0 otherwise)
+
+
+def static_schedule(n_steps: int, cache_interval: int,
+                    cache_mode: str = "delta") -> np.ndarray:
+    """The branch sequence the STATIC schedule alone would take — what the
+    gate's output collapses to at τ=∞ (never promote) and the baseline the
+    refresh-promotion count is measured against."""
+    from ddim_cold_tpu.ops import schedule
+
+    return np.asarray(
+        schedule.cache_branch_sequence(n_steps, cache_interval, cache_mode),
+        dtype=np.int32)
+
+
+def summarize(tel: "StepTelemetry", *, cache_interval: int,
+              cache_mode: str, cache_threshold: float = 0.0,
+              cache_tokens: int = 0) -> dict:
+    """Render a telemetry aux into the per-ticket summary dict.
+
+    ``promoted_refreshes`` counts reuse steps the adaptive gate promoted to
+    refresh beyond the static schedule — 0 for non-adaptive modes by
+    construction, and exactly the quantity the drift threshold τ trades
+    against speed.
+    """
+    from ddim_cold_tpu.ops import schedule
+
+    branch = np.asarray(tel.branch)
+    drift = np.asarray(tel.drift, dtype=np.float64)
+    n_steps = int(branch.size)
+    refreshes = int(np.sum(branch == schedule.CACHE_REFRESH))
+    planned = static_schedule(n_steps, cache_interval, cache_mode)
+    planned_refreshes = int(np.sum(planned == schedule.CACHE_REFRESH))
+    return {
+        "steps": n_steps,
+        "cache_mode": cache_mode,
+        "cache_interval": cache_interval,
+        "cache_threshold": cache_threshold,
+        "cache_tokens": cache_tokens,
+        "refreshes": refreshes,
+        "reuses": n_steps - refreshes,
+        "planned_refreshes": planned_refreshes,
+        "promoted_refreshes": refreshes - planned_refreshes,
+        "refresh_ratio": round(refreshes / n_steps, 4) if n_steps else 0.0,
+        "drift_max": float(drift.max()) if n_steps else 0.0,
+        "drift_mean": float(drift.mean()) if n_steps else 0.0,
+        "branch": branch.tolist(),
+        "drift": [round(float(d), 6) for d in drift],
+    }

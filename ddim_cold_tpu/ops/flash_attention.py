@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ddim_cold_tpu.ops import tiling
+from ddim_cold_tpu.utils import profiling
 
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: last dim of VMEM tiles
@@ -187,32 +188,33 @@ def _flash_forward(q, k, v, scale, block_q, block_kv):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, n_valid=N,
                                block_kv=bkv, n_kv=n_kv)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            _sds(qh.shape, q.dtype, qh),
-            _sds((*qh.shape[:2], _LANE), jnp.float32, qh),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
-            pltpu.VMEM((bq, _LANE), jnp.float32),  # running max (lane-replicated)
-            pltpu.VMEM((bq, _LANE), jnp.float32),  # running denominator
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=jax.default_backend() == "cpu",
-    )(qh, kh, vh)
+    with profiling.scope("flash_attention/fwd"):
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, Dp), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                _sds(qh.shape, q.dtype, qh),
+                _sds((*qh.shape[:2], _LANE), jnp.float32, qh),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, Dp), jnp.float32),    # output accumulator
+                pltpu.VMEM((bq, _LANE), jnp.float32),  # running max
+                pltpu.VMEM((bq, _LANE), jnp.float32),  # running denominator
+            ],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+            interpret=jax.default_backend() == "cpu",
+        )(qh, kh, vh)
 
     out = out[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)
     # drop the lane replication before the lse becomes a VJP residual —
@@ -330,38 +332,41 @@ def _flash_backward(q, k, v, o, lse, g, scale, block_q, block_kv):
     kv_spec_dq = pl.BlockSpec((1, bkv, Dp), lambda b, i, j: (b, j, 0))
     row_spec = pl.BlockSpec((1, bq, _LANE), lambda b, i, j: (b, i, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, n_valid=N,
-                          block_q=bq, block_kv=bkv, n_kv=n_kv),
-        grid=(BH, n_q, n_kv),
-        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        out_shape=_sds(qh.shape, q.dtype, qh),
-        scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    with profiling.scope("flash_attention/dq"):
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, n_valid=N,
+                              block_q=bq, block_kv=bkv, n_kv=n_kv),
+            grid=(BH, n_q, n_kv),
+            in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec,
+                      row_spec],
+            out_specs=q_spec,
+            out_shape=_sds(qh.shape, q.dtype, qh),
+            scratch_shapes=[pltpu.VMEM((bq, Dp), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qh, kh, vh, gh, lse, delta)
 
     # transposed grid: (head, kv block, q chunk innermost)
     q_spec_t = pl.BlockSpec((1, bq, Dp), lambda b, j, i: (b, i, 0))
     kv_spec_t = pl.BlockSpec((1, bkv, Dp), lambda b, j, i: (b, j, 0))
     row_spec_t = pl.BlockSpec((1, bq, _LANE), lambda b, j, i: (b, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, n_valid=N,
-                          block_q=bq, block_kv=bkv, n_q=n_q),
-        grid=(BH, n_kv, n_q),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
-                  row_spec_t],
-        out_specs=[kv_spec_t, kv_spec_t],
-        out_shape=[_sds(kh.shape, k.dtype, kh),
-                   _sds(vh.shape, v.dtype, vh)],
-        scratch_shapes=[pltpu.VMEM((bkv, Dp), jnp.float32),
-                        pltpu.VMEM((bkv, Dp), jnp.float32)],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    with profiling.scope("flash_attention/dkv"):
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, n_valid=N,
+                              block_q=bq, block_kv=bkv, n_q=n_q),
+            grid=(BH, n_kv, n_q),
+            in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                      row_spec_t],
+            out_specs=[kv_spec_t, kv_spec_t],
+            out_shape=[_sds(kh.shape, k.dtype, kh),
+                       _sds(vh.shape, v.dtype, vh)],
+            scratch_shapes=[pltpu.VMEM((bkv, Dp), jnp.float32),
+                            pltpu.VMEM((bkv, Dp), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qh, kh, vh, gh, lse, delta)
 
     def from_heads(x):
         return x[:, :N, :D].reshape(B, H, N, D).transpose(0, 2, 1, 3)

@@ -57,6 +57,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ddim_cold_tpu.ops import tiling
+from ddim_cold_tpu.utils import profiling
 
 #: Pallas-TPU compiler params across jax versions (same shim as
 #: ops/flash_attention.py — renamed TPUCompilerParams → CompilerParams)
@@ -290,21 +291,23 @@ def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
     sp = _pad_axis(scale.astype(jnp.float32)[None, :], 1, _round_up(N, bn))
     n_k = xp.shape[1] // bk
 
-    out = pl.pallas_call(
-        functools.partial(_mm_kernel, n_k=n_k),
-        grid=(xp.shape[0] // bm, wp.shape[1] // bn, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=jax.default_backend() == "cpu",
-    )(xp, wp, sp)
+    with profiling.scope("dequant_matmul/pallas"):
+        out = pl.pallas_call(
+            functools.partial(_mm_kernel, n_k=n_k),
+            grid=(xp.shape[0] // bm, wp.shape[1] // bn, n_k),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+                pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]),
+                                           jnp.float32),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=jax.default_backend() == "cpu",
+        )(xp, wp, sp)
     return out[:M, :N]
 
 

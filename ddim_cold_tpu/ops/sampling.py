@@ -31,7 +31,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ddim_cold_tpu.obs.device import StepTelemetry
 from ddim_cold_tpu.ops import schedule, step_cache
+from ddim_cold_tpu.utils import profiling
 
 
 def forward_noise(rng: jax.Array, img: jax.Array, t_start: int, total_steps: int = 2000):
@@ -71,7 +73,9 @@ def _ddim_scan_sequence(model, params, x_init, noise_rng, *, k: int,
 
     def step(x, inputs):
         t, c1, c2, cz = inputs
-        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        with profiling.scope("sampler/model"):
+            x0 = model.apply({"params": params}, x,
+                             jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
         return _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta), x0
 
@@ -92,7 +96,9 @@ def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
     def step(carry, inputs):
         x, _ = carry
         t, c1, c2, cz = inputs
-        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        with profiling.scope("sampler/model"):
+            x0 = model.apply({"params": params}, x,
+                             jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
         return (_ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta),
                 x0), None
@@ -141,8 +147,10 @@ def _ddim_cached_impl(model, params, x_init, noise_rng, cache0, *, k: int,
     def step(carry, inputs):
         x, x0_prev, cache = carry
         (t, c1, c2, cz), br = inputs
-        x0_raw, cache = step_cache.apply_step(
-            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        with profiling.scope("sampler/cached_step"):
+            x0_raw, cache = step_cache.apply_step(
+                model, params, x, jnp.full((n,), t, jnp.int32), br, cache,
+                spec)
         x0 = jnp.clip(x0_raw, -1.0, 1.0)
         x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
         return (x_next, x0, cache), (x0 if sequence else None)
@@ -172,6 +180,51 @@ _ddim_scan_cached_seq = jax.jit(_ddim_cached_impl,
                                 static_argnames=_CACHED_STATICS)
 
 
+def _ddim_cached_tel_impl(model, params, x_init, noise_rng, cache0, *, k: int,
+                          t_start: Optional[int], eta: float,
+                          cache_interval: int, cache_mode: str,
+                          cache_threshold=None, cache_tokens=None):
+    """``_ddim_cached_impl`` with on-device step telemetry: the same cached
+    scan, but each step also stacks the cache branch ACTUALLY taken (the
+    adaptive gate's post-promotion index — ``ops/step_cache.apply_step_tel``)
+    and the gate's drift value into a static-shaped ``(n_steps,)`` aux.
+    Last-only (no ``sequence`` static — previews and telemetry are separate
+    products; serve/batching.py rejects the combination), so the telemetry
+    program keys on one fewer static than the plain cached scan. Returns
+    ``(images, final_cache, (branch, drift))``; the host side decodes the
+    aux via ``obs.device.summarize``."""
+    coeffs = schedule.ddim_coefficients(model.total_steps, k, t_start, eta)
+    spec = _cached_spec(model, len(coeffs.t_seq), cache_interval, cache_mode,
+                        cache_threshold, cache_tokens)
+    n = x_init.shape[0]
+
+    def step(carry, inputs):
+        x, x0_prev, cache = carry
+        (t, c1, c2, cz), br = inputs
+        with profiling.scope("sampler/cached_step"):
+            x0_raw, cache, idx, drift = step_cache.apply_step_tel(
+                model, params, x, jnp.full((n,), t, jnp.int32), br, cache,
+                spec)
+        x0 = jnp.clip(x0_raw, -1.0, 1.0)
+        x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
+        return (x_next, x0, cache), (idx, drift)
+
+    carry0 = (x_init, jnp.zeros_like(x_init), cache0)
+    branches = jnp.asarray(spec.branches, jnp.int32)
+    (_, x0_last, cache_out), (br_seq, drift_seq) = jax.lax.scan(
+        step, carry0, (_scan_inputs(coeffs), branches))
+    return (x0_last + 1.0) / 2.0, cache_out, (br_seq, drift_seq)
+
+
+_CACHED_TEL_STATICS = ("model", "k", "t_start", "eta", "cache_interval",
+                       "cache_mode", "cache_threshold", "cache_tokens")
+#: donation mirrors the last-only cached scan (x_init/cache alias outputs;
+#: the tiny (n_steps,) aux allocates fresh — negligible).
+_ddim_scan_cached_tel = jax.jit(_ddim_cached_tel_impl,
+                                static_argnames=_CACHED_TEL_STATICS,
+                                donate_argnames=("x_init", "cache0"))
+
+
 def _ddim_inpaint_impl(model, params, x_init, known, mask, noise_rng, *,
                        k: int, t_start: Optional[int], eta: float,
                        sequence: bool):
@@ -193,7 +246,9 @@ def _ddim_inpaint_impl(model, params, x_init, known, mask, noise_rng, *,
     def step(carry, inputs):
         x, _ = carry
         t, c1, c2, cz = inputs
-        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        with profiling.scope("sampler/model"):
+            x0 = model.apply({"params": params}, x,
+                             jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
         x0 = mask * known + (1.0 - mask) * x0
         return (_ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta),
@@ -240,8 +295,10 @@ def _ddim_inpaint_cached_impl(model, params, x_init, known, mask, noise_rng,
     def step(carry, inputs):
         x, _, cache = carry
         (t, c1, c2, cz), br = inputs
-        x0_raw, cache = step_cache.apply_step(
-            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        with profiling.scope("sampler/cached_step"):
+            x0_raw, cache = step_cache.apply_step(
+                model, params, x, jnp.full((n,), t, jnp.int32), br, cache,
+                spec)
         x0 = jnp.clip(x0_raw, -1.0, 1.0)
         x0 = mask * known + (1.0 - mask) * x0
         x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
@@ -311,6 +368,7 @@ def ddim_sample(
     cache_mode: str = "delta",
     cache_threshold: Optional[float] = None,
     cache_tokens: Optional[int] = None,
+    telemetry: bool = False,
 ) -> jax.Array:
     """k-strided DDIM sampling; returns images in [0, 1], NHWC.
 
@@ -360,6 +418,14 @@ def ddim_sample(
 
     Both statics are part of the compiled-program key; they are rejected
     (by ops/step_cache.cache_spec) under any other ``cache_mode``.
+
+    ``telemetry=True`` (requires the cached sampler, i.e.
+    ``cache_interval`` > 1, and is last-only) additionally returns an
+    ``obs.device.StepTelemetry`` aux — per scan step, the cache branch
+    actually taken (post adaptive-gate promotion) and the gate's drift —
+    as ``(images, telemetry)``. The aux is static-shaped and rides the
+    same scan, so it costs no extra dispatches or compiles; images are
+    bitwise identical with telemetry on or off.
     """
     if eta and rng is None:
         raise ValueError("eta > 0 draws per-step noise — pass rng")
@@ -379,6 +445,20 @@ def ddim_sample(
     # per-step noise must not be correlated with it
     noise_rng = (jax.random.fold_in(rng, 0xD1F) if rng is not None
                  else jax.random.PRNGKey(0))
+    if telemetry:
+        if return_sequence:
+            raise ValueError("telemetry=True is last-only — previews and "
+                             "telemetry are separate products")
+        if not step_cache.enabled(cache_interval):
+            raise ValueError("telemetry=True needs the cached sampler "
+                             "(cache_interval > 1)")
+        out, _, (br, drift) = _ddim_scan_cached_tel(
+            model, params, x_init, noise_rng,
+            _make_cache(model, x_init, mesh, cache_mode),
+            k=k, t_start=t_start, eta=eta, cache_interval=cache_interval,
+            cache_mode=cache_mode, cache_threshold=cache_threshold,
+            cache_tokens=cache_tokens)
+        return out, StepTelemetry(branch=br, drift=drift)
     if step_cache.enabled(cache_interval):
         fn = _ddim_scan_cached_seq if return_sequence else _ddim_scan_cached
         out, _ = fn(
@@ -498,7 +578,9 @@ def _cold_impl(model, params, x_init, *, levels: int, return_sequence: bool):
     n = x_init.shape[0]
 
     def step(x, t):
-        x0 = model.apply({"params": params}, x, jnp.full((n,), t, jnp.int32))
+        with profiling.scope("sampler/model"):
+            x0 = model.apply({"params": params}, x,
+                             jnp.full((n,), t, jnp.int32))
         x0 = jnp.clip(x0, -1.0, 1.0)
         # naive Cold-Diffusion Algorithm 1: x ← clamp(f(x, t)); the reference's
         # DDIM-style correction is present upstream only as commented-out code
@@ -536,8 +618,10 @@ def _cold_cached_impl(model, params, x_init, cache0, *, levels: int,
     def step(carry, inputs):
         x, cache = carry
         t, br = inputs
-        x0_raw, cache = step_cache.apply_step(
-            model, params, x, jnp.full((n,), t, jnp.int32), br, cache, spec)
+        with profiling.scope("sampler/cached_step"):
+            x0_raw, cache = step_cache.apply_step(
+                model, params, x, jnp.full((n,), t, jnp.int32), br, cache,
+                spec)
         x0 = jnp.clip(x0_raw, -1.0, 1.0)
         return (x0, cache), (x0 if return_sequence else None)
 

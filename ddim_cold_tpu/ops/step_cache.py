@@ -194,6 +194,46 @@ def shard_cache(cache: Cache, mesh) -> Cache:
     return jax.tree.map(lambda a: jax.device_put(a, sharding), cache)
 
 
+def adaptive_gate(x: jax.Array, cache: Cache, branch: jax.Array,
+                  spec: CacheSpec):
+    """The error gate of ``"adaptive"`` mode: fold the on-device drift into
+    the step's branch index. Returns ``(idx, drift)``.
+
+    Drift is computed per ROW and reduced with max: the gate is a
+    batch-level scalar (``lax.switch`` takes one index) but the max keeps it
+    invariant to padding rows that replicate a real row (serve/engine.py).
+    ``>=`` makes threshold=0 an always-refresh gate — bitwise the exact
+    sampler; a stale/zero ``x_ref`` is harmless because step 0's branch id
+    is CACHE_REFRESH and the ``jnp.where`` pins idx to 0 there no matter
+    what drift evaluates to."""
+    x_ref = cache[2]
+    axes = tuple(range(1, x_ref.ndim))
+    xf = x.astype(jnp.float32)
+    num = jnp.sum(jnp.square(xf - x_ref), axis=axes)
+    den = jnp.sum(jnp.square(x_ref), axis=axes) + DRIFT_EPS
+    drift = jnp.max(num / den)
+    idx = jnp.where((branch == schedule.CACHE_REFRESH)
+                    | (drift >= spec.threshold),
+                    schedule.CACHE_REFRESH, branch)
+    return idx, drift
+
+
+def apply_step_tel(model, params, x: jax.Array, t_vec: jax.Array,
+                   branch: jax.Array, cache: Cache, spec: CacheSpec):
+    """:func:`apply_step` plus the step's telemetry aux — returns
+    ``(x0_raw, new_cache, idx, drift)`` where ``idx`` is the branch
+    ACTUALLY taken (post-gate in adaptive mode, the static branch
+    otherwise) and ``drift`` the gate's value (0 for modes that never
+    compute one). A separate entry point so telemetry-off programs trace
+    exactly the pre-existing jaxpr (obs/device.py holds the host side)."""
+    if spec.mode == "adaptive":
+        idx, drift = adaptive_gate(x, cache, branch, spec)
+    else:
+        idx, drift = branch, jnp.float32(0.0)
+    x0, new_cache = apply_step(model, params, x, t_vec, branch, cache, spec)
+    return x0, new_cache, idx, drift
+
+
 def apply_step(model, params, x: jax.Array, t_vec: jax.Array,
                branch: jax.Array, cache: Cache, spec: CacheSpec):
     """One cache-aware model evaluation inside the sampler scan body.
@@ -238,22 +278,7 @@ def apply_step(model, params, x: jax.Array, t_vec: jax.Array,
                              skip_blocks=(0, split), block_delta=cache[0])
             return x0, cache
 
-        # drift per ROW, reduced with max: the gate is a batch-level scalar
-        # (lax.switch takes one index) but the max keeps it invariant to
-        # padding rows that replicate a real row (serve/engine.py). `>=`
-        # makes threshold=0 an always-refresh gate — bitwise the exact
-        # sampler; a stale/zero x_ref is harmless because step 0's branch id
-        # is CACHE_REFRESH and the jnp.where below pins idx to 0 there no
-        # matter what drift evaluates to.
-        x_ref = cache[2]
-        axes = tuple(range(1, x_ref.ndim))
-        xf = x.astype(jnp.float32)
-        num = jnp.sum(jnp.square(xf - x_ref), axis=axes)
-        den = jnp.sum(jnp.square(x_ref), axis=axes) + DRIFT_EPS
-        drift = jnp.max(num / den)
-        idx = jnp.where((branch == schedule.CACHE_REFRESH)
-                        | (drift >= spec.threshold),
-                        schedule.CACHE_REFRESH, branch)
+        idx, _ = adaptive_gate(x, cache, branch, spec)
         return jax.lax.switch(idx, (refresh, reuse_rear, reuse_front),
                               x, cache)
 

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from ddim_cold_tpu.parallel import _compat
 from ddim_cold_tpu.parallel._compat import shard_map
+from ddim_cold_tpu.utils import profiling
 
 _NEG_INF = -1e30
 
@@ -73,9 +74,10 @@ def ring_attention(
         o, l, m, k_blk, v_blk, valid_blk = carry
         o, l, m = accumulate(o, l, m, k_blk, v_blk, valid_blk)
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        valid_blk = jax.lax.ppermute(valid_blk, axis_name, perm)
+        with profiling.scope("sp/ring_exchange"):
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            valid_blk = jax.lax.ppermute(valid_blk, axis_name, perm)
         return o, l, m, k_blk, v_blk, valid_blk
 
     # axis_size − 1 rotations; the final block is consumed outside the loop so

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from ddim_cold_tpu.parallel._compat import shard_map
+from ddim_cold_tpu.utils import profiling
 
 
 class SeqParallelConfigError(ValueError):
@@ -78,7 +79,8 @@ def ulysses_attention(
     # its H_loc/S heads
     gather = partial(jax.lax.all_to_all, axis_name=axis_name,
                      split_axis=2, concat_axis=1, tiled=True)
-    qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H_loc/S, D)
+    with profiling.scope("sp/all_to_all_gather"):
+        qf, kf, vf = gather(q), gather(k), gather(v)  # (B', Np, H_loc/S, D)
     qf, kf, vf = (x[:, :n_valid] for x in (qf, kf, vf))
 
     if use_flash == "xla":
@@ -103,8 +105,9 @@ def ulysses_attention(
     if n_pad:
         out = jnp.pad(out, [(0, 0), (0, n_pad), (0, 0), (0, 0)])
     # head-sharded → seq-sharded
-    return jax.lax.all_to_all(out, axis_name=axis_name,
-                              split_axis=1, concat_axis=2, tiled=True)
+    with profiling.scope("sp/all_to_all_scatter"):
+        return jax.lax.all_to_all(out, axis_name=axis_name,
+                                  split_axis=1, concat_axis=2, tiled=True)
 
 
 def ulysses_self_attention(

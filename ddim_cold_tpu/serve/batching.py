@@ -78,6 +78,11 @@ class SamplerConfig:
     # the engine builds for this config (its local device count must divide
     # by it). Static: part of the program key — sp and non-sp requests never
     # coalesce, they run differently-sharded programs.
+    telemetry: bool = False        # True: the cached DDIM scan also stacks
+    # its per-step (branch, drift) aux (ops/step_cache.apply_step_tel) and
+    # the engine decodes it into ``Ticket.telemetry`` (obs/device.py).
+    # Static: selects a distinct compiled program (one extra warmup entry);
+    # images stay bitwise identical with telemetry on or off.
 
     def __post_init__(self):
         if self.sampler not in _SAMPLERS:
@@ -167,6 +172,23 @@ class SamplerConfig:
                 "take DIFFERENT refresh branches and desynchronize the "
                 "carry — use cache_mode='delta'/'full'/'token' with sp, or "
                 "sp_degree=1 for adaptive caching")
+        if self.telemetry:
+            if self.sampler != "ddim" or not self.cached:
+                raise ValueError(
+                    "telemetry=True decodes the cached DDIM scan's step aux "
+                    "— pass sampler='ddim' with cache_interval > 1")
+            if self.task != "sample":
+                raise ValueError(
+                    "telemetry=True is the plain sampling path — task "
+                    f"{self.task!r} has no telemetry scan variant")
+            if self.preview_every:
+                raise ValueError(
+                    "telemetry and previews are separate products — the "
+                    "telemetry scan is last-only (drop preview_every)")
+            if self.sp_mode != "none":
+                raise ValueError(
+                    "telemetry does not compose with sequence parallelism — "
+                    "use sp_degree=1 (default) for telemetry configs")
     @property
     def cached(self) -> bool:
         return self.cache_interval > 1
@@ -211,6 +233,12 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self._health_cb = None  # engine attaches its health snapshot hook
         self._callbacks: list = []
+        #: obs root span for this request (obs/spans.py) — set by the engine
+        #: or router at submit when tracing is enabled, else None
+        self.span = None
+        #: per-request step-telemetry summary (obs/device.summarize) — set
+        #: at finish for SamplerConfig(telemetry=True) requests, else None
+        self.telemetry: Optional[dict] = None
         # streaming previews (SamplerConfig.preview_every): per-step frame
         # assembly (a split request's preview rows land batch by batch, like
         # the result) + completed-frame history. _pcond serializes history
@@ -374,7 +402,12 @@ class Ticket:
                 f"({self._remaining} rows outstanding)")
         if self._health_cb is not None:
             try:
-                return f"{base}; engine health: {self._health_cb()}"
+                health = self._health_cb()
+                stage = health.get("last_stage")
+                if stage is not None:
+                    base += (f"; engine last seen at stage {stage!r}, "
+                             f"{health.get('stalled_for_s')}s ago")
+                return f"{base}; engine health: {health}"
             except Exception:  # noqa: BLE001 — diagnostics must not mask
                 return base
         return base + " — no engine attached (did Engine.run() run?)"

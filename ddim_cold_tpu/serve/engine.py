@@ -94,6 +94,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ddim_cold_tpu.data.loader import device_prefetch
+from ddim_cold_tpu.obs import device as obs_device
+from ddim_cold_tpu.obs import metrics, spans
 from ddim_cold_tpu.ops import sampling, step_cache
 from ddim_cold_tpu.parallel.mesh import (batch_sharding, data_axis_size,
                                          make_mesh, shard_params)
@@ -218,15 +220,40 @@ class Engine:
         # replica from the snapshot alone, before the watchdog fires
         self._last_mark = (self._t0, "init")
         self.quarantined: list[int] = []  # rids bisection isolated
-        self.stats = {"compiles": 0, "dispatches": 0, "rows": 0,
-                      "padded_rows": 0, "max_queue_depth": 0,
-                      "preview_frames": 0,
-                      "latencies_s": [], "param_bytes": None,
-                      "param_bytes_quant": None,
-                      # robustness counters (health snapshot)
-                      "retries": 0, "failed_batches": 0, "failed_tickets": 0,
-                      "quarantined": 0, "deadline_expired": 0, "rejected": 0,
-                      "skipped_batches": 0, "stalls": 0}
+        #: obs emit handle (scope id ``engine#N`` — per instance, so a
+        #: multi-replica fleet's counters never alias): every counter the
+        #: old hand-rolled stats dict tracked now lives in the process
+        #: metrics registry (obs/metrics.py); :attr:`stats` is a read-only
+        #: legacy view rendered from it. Public so warmup() reports its
+        #: compile counts under the engine it warmed.
+        self.metrics = metrics.scope("engine")
+
+    @property
+    def stats(self) -> dict:
+        """Legacy stats surface, rendered from the metrics registry — the
+        same keys/semantics the hand-maintained dict had (``param_bytes``
+        is None until the quant tree is built; ``latencies_s`` is the raw
+        per-ticket sample list)."""
+        m = self.metrics
+        return {
+            "compiles": m.value("engine.compiles"),
+            "dispatches": m.value("engine.dispatches"),
+            "rows": m.value("engine.rows"),
+            "padded_rows": m.value("engine.padded_rows"),
+            "max_queue_depth": int(m.raw("engine.max_queue_depth") or 0),
+            "preview_frames": m.value("engine.preview_frames"),
+            "latencies_s": m.samples("engine.latency_s"),
+            "param_bytes": m.raw("engine.param_bytes"),
+            "param_bytes_quant": m.raw("engine.param_bytes_quant"),
+            "retries": m.value("engine.retries"),
+            "failed_batches": m.value("engine.failed_batches"),
+            "failed_tickets": m.value("engine.failed_tickets"),
+            "quarantined": m.value("engine.quarantined"),
+            "deadline_expired": m.value("engine.deadline_expired"),
+            "rejected": m.value("engine.rejected"),
+            "skipped_batches": m.value("engine.skipped_batches"),
+            "stalls": m.value("engine.stalls"),
+        }
 
     # ---------------------------------------------------------------- submit
 
@@ -235,7 +262,8 @@ class Engine:
                x_init: Optional[np.ndarray] = None,
                mask: Optional[np.ndarray] = None,
                config: Optional[SamplerConfig] = None,
-               deadline_s: Optional[float] = None, **kwargs) -> Ticket:
+               deadline_s: Optional[float] = None,
+               trace=None, **kwargs) -> Ticket:
         """Queue a sampling request; returns its :class:`Ticket`.
 
         Fresh starts pass ``seed`` (or a jax ``rng`` key) — the engine draws
@@ -259,6 +287,11 @@ class Engine:
         occupying a bucket. Raises :class:`QueueFullError` when the bounded
         queue is at ``max_queue`` and :class:`EngineClosedError` after
         :meth:`drain`.
+
+        ``trace`` (an ``obs.spans`` TraceContext/Span, or None) parents this
+        request's span when tracing is enabled — the fleet router passes its
+        placement-attempt span here so hedged attempts land in ONE trace.
+        With no parent, the request starts a fresh trace.
         """
         if config is None:
             config = SamplerConfig(**kwargs)
@@ -318,7 +351,7 @@ class Engine:
                 raise EngineClosedError(
                     "engine is drained — no new requests accepted")
             if self.max_queue is not None and len(self._pending) >= self.max_queue:
-                self.stats["rejected"] += 1
+                self.metrics.inc("engine.rejected")
                 raise QueueFullError(
                     f"queue at max_queue={self.max_queue} "
                     f"({len(self._pending)} pending) — request rejected "
@@ -328,7 +361,13 @@ class Engine:
             self._pending.append(req)
             self._open[req.rid] = req
             depth = len(self._pending)
-        self.stats["max_queue_depth"] = max(self.stats["max_queue_depth"], depth)
+        self.metrics.gauge(
+            "engine.max_queue_depth",
+            max(int(self.metrics.raw("engine.max_queue_depth") or 0), depth))
+        if spans.enabled():
+            req.ticket.span = spans.begin(
+                "engine.request", parent=trace, rid=req.rid, n=req.n,
+                replica=self.replica_id) or None
         return req.ticket
 
     @staticmethod
@@ -368,7 +407,7 @@ class Engine:
             self._mark(f"compile bucket={bucket}", budget_s=4 * self.stall_s)
             prog = self._build_program(config, bucket)
             self._programs[key] = prog
-            self.stats["compiles"] += 1
+            self.metrics.inc("engine.compiles")
         return prog
 
     # -------------------------------------------------- sequence parallelism
@@ -460,9 +499,10 @@ class Engine:
                 qp = quant.quantize_params(self.params)
                 self._qparams = (shard_params(qp, self.mesh)
                                  if self.mesh is not None else qp)
-                self.stats["param_bytes"] = quant.param_bytes(self.params)
-                self.stats["param_bytes_quant"] = quant.param_bytes(
-                    self._qparams)
+                self.metrics.gauge("engine.param_bytes",
+                                   quant.param_bytes(self.params))
+                self.metrics.gauge("engine.param_bytes_quant",
+                                   quant.param_bytes(self._qparams))
             base = self._qparams
         if config.sp_degree == 1:
             return base
@@ -540,6 +580,10 @@ class Engine:
                 model, params, x, levels=config.levels,
                 return_sequence=seq).compile()
         if config.cached:
+            if config.telemetry:
+                return _ddim_cached_tel_lower(
+                    model, params, x, self._key0,
+                    self._cache_struct(bucket, config), config)
             return _ddim_cached_lower(model, params, x, self._key0,
                                       self._cache_struct(bucket, config),
                                       config, seq)
@@ -610,6 +654,7 @@ class Engine:
         reduction is exactly what the direct unpadded call computes — the
         bitwise-vs-direct contract survives padding."""
         self._mark(f"assemble bucket={plan.bucket}")
+        t0 = spans.now() if spans.enabled() else 0.0
         faults.fire("serve.assemble", tag=self._tag(plan))
         coupled = plan.config.batch_coupled
 
@@ -639,7 +684,22 @@ class Engine:
             if sharding is not None:
                 e = jax.device_put(e, sharding)
             xs.append(e)
+        self._record_stage(plan, "assemble", t0)
         return plan, tuple(xs)
+
+    def _record_stage(self, plan: BatchPlan, name: str, t0: float,
+                      **attrs) -> None:
+        """Attribute one per-batch pipeline stage to every request riding
+        the batch: a retroactive closed span (same measured window) under
+        each request's trace — so a split request's trace shows the stage
+        once per batch it rode, and a coalesced batch's window appears under
+        every participant. No-op with tracing disabled."""
+        if not spans.enabled():
+            return
+        t1 = spans.now()
+        for req in {id(r): r for r, *_ in plan.entries}.values():
+            spans.record(req.ticket.span, name, t0, t1,
+                         bucket=plan.bucket, **attrs)
 
     def _assemble_safe(self, plan: BatchPlan):
         """Assembly with the exception CAPTURED, not raised — the prefetch
@@ -702,6 +762,7 @@ class Engine:
         prog = self.ensure_program(plan.config, plan.bucket)
         params = self._params_for(plan.config)
         self._mark(f"dispatch bucket={plan.bucket}")
+        t0 = spans.now() if spans.enabled() else 0.0
         faults.fire("serve.dispatch", tag=self._tag(plan))
         if plan.config.task == "inpaint":
             x, known, m = xs
@@ -722,16 +783,23 @@ class Engine:
                 out = prog(params, x)
         elif plan.config.cached:
             x, = xs
-            out, cache_out = prog(
-                params, x, self._key0,
-                self._take_cache(plan.bucket, plan.config))
+            if plan.config.telemetry:
+                out, cache_out, aux = prog(
+                    params, x, self._key0,
+                    self._take_cache(plan.bucket, plan.config))
+                out = (out, aux)
+            else:
+                out, cache_out = prog(
+                    params, x, self._key0,
+                    self._take_cache(plan.bucket, plan.config))
             self._recycle_cache(plan.bucket, plan.config, cache_out)
         else:
             x, = xs
             out = prog(params, x, self._key0)
-        self.stats["dispatches"] += 1
-        self.stats["rows"] += plan.rows
-        self.stats["padded_rows"] += plan.padded_rows
+        self.metrics.inc("engine.dispatches")
+        self.metrics.inc("engine.rows", plan.rows)
+        self.metrics.inc("engine.padded_rows", plan.padded_rows)
+        self._record_stage(plan, "dispatch", t0)
         return out
 
     def _dispatch_retry(self, plan: BatchPlan, xs):
@@ -747,7 +815,7 @@ class Engine:
             except RETRYABLE_EXCEPTIONS:
                 if attempt == self.max_retries:
                     raise
-                self.stats["retries"] += 1
+                self.metrics.inc("engine.retries")
                 time.sleep(min(delay, self.retry_cap_s))
                 delay = min(delay * 2, self.retry_cap_s)
                 if getattr(xs[0], "is_deleted", lambda: False)():
@@ -781,19 +849,19 @@ class Engine:
         for req, *_ in plan.entries:
             if req.deadline is not None and now > req.deadline \
                     and not req.ticket.done:
-                self.stats["deadline_expired"] += 1
+                self.metrics.inc("engine.deadline_expired", key="dispatch")
                 self._fail_request(req, DeadlineExceeded(
                     f"request {req.rid} missed its deadline before dispatch "
                     f"on {self._rname} (expired {now - req.deadline:.3f}s "
                     "ago waiting for a bucket) — failing fast instead of "
                     "occupying one"))
         if all(req.ticket.failed for req, *_ in plan.entries):
-            self.stats["skipped_batches"] += 1
+            self.metrics.inc("engine.skipped_batches")
             return []
         try:
             return [(plan, self._dispatch_retry(plan, xs))]
         except Exception as exc:  # noqa: BLE001 — isolate, bisect, quarantine
-            self.stats["failed_batches"] += 1
+            self.metrics.inc("engine.failed_batches", key="dispatch")
             reqs = list({id(r): r for r, *_ in plan.entries}.values())
             if len(reqs) == 1:
                 req = reqs[0]
@@ -805,7 +873,7 @@ class Engine:
                         "separately")
                     err.__cause__ = exc
                     self.quarantined.append(req.rid)
-                    self.stats["quarantined"] += 1
+                    self.metrics.inc("engine.quarantined")
                     self._fail_request(req, err)
                 return []
             results = []
@@ -831,18 +899,45 @@ class Engine:
         Preview-enabled configs fetch the whole trajectory: the scheduled
         intermediate x̂0 frames stream to each ticket's preview buffer
         (``Ticket.previews()``) before the FINAL frame — bitwise the
-        last-only program's output — is delivered as the result."""
+        last-only program's output — is delivered as the result.
+
+        Telemetry configs (``SamplerConfig.telemetry``) arrive here as
+        ``(images, (branch, drift))``: the static-shaped step aux is fetched
+        with the batch, decoded once (``obs.device.summarize``), attached to
+        every participating ticket BEFORE delivery (a ``result()`` waiter
+        wakes to a populated ``Ticket.telemetry``), and its refresh/reuse
+        step counts emitted. Batch == request for the coupled adaptive case;
+        the static modes' aux is identical for every batchmate anyway."""
         try:
             self._mark(f"fetch bucket={plan.bucket}")
+            t0 = spans.now() if spans.enabled() else 0.0
+            aux = None
+            if plan.config.telemetry:
+                out, (br, dr) = out
+                aux = (np.asarray(br), np.asarray(dr))
             host = np.asarray(out)
             host = faults.fire("serve.fetch", tag=self._tag(plan),
                                payload=host)
         except Exception as exc:  # noqa: BLE001 — isolated per batch
             self._fail_plan(plan, exc, "fetch")
             return
+        self._record_stage(plan, "fetch", t0)
+        if aux is not None:
+            cfg = plan.config
+            summary = obs_device.summarize(
+                obs_device.StepTelemetry(branch=aux[0], drift=aux[1]),
+                cache_interval=cfg.cache_interval, cache_mode=cfg.cache_mode,
+                cache_threshold=cfg.cache_threshold or 0.0,
+                cache_tokens=cfg.cache_tokens)
+            self.metrics.inc("engine.cache_refresh_steps",
+                             summary["refreshes"])
+            self.metrics.inc("engine.cache_reuse_steps", summary["reuses"])
+            for req in {id(r): r for r, *_ in plan.entries}.values():
+                req.ticket.telemetry = summary
         every = plan.config.preview_every
         if every:
             try:
+                t0 = spans.now() if spans.enabled() else 0.0
                 faults.fire("serve.preview", tag=self._tag(plan))
                 steps = host.shape[0] - 1  # frame 0 is the init
                 for j in workload_preview.preview_indices(steps, every):
@@ -850,14 +945,19 @@ class Engine:
                     for req, lo, hi, offset in plan.entries:
                         if req.ticket._preview(
                                 j, lo, hi, frame[offset:offset + (hi - lo)]):
-                            self.stats["preview_frames"] += 1
+                            self.metrics.inc("engine.preview_frames")
             except Exception as exc:  # noqa: BLE001 — isolated per batch
                 self._fail_plan(plan, exc, "preview")
                 return
+            self._record_stage(plan, "preview", t0)
             host = host[-1]
         for req, lo, hi, offset in plan.entries:
             if req.ticket._deliver(lo, hi, host[offset:offset + (hi - lo)]):
-                self.stats["latencies_s"].append(req.ticket.latency_s)
+                self.metrics.observe("engine.latency_s",
+                                     req.ticket.latency_s)
+                sp = req.ticket.span
+                if sp is not None:
+                    sp.end(rows=req.n, latency_s=req.ticket.latency_s)
                 with self._lock:
                     self._open.pop(req.rid, None)
 
@@ -867,12 +967,15 @@ class Engine:
         with self._lock:
             self._open.pop(req.rid, None)
         if req.ticket._fail(exc):
-            self.stats["failed_tickets"] += 1
+            self.metrics.inc("engine.failed_tickets")
+            sp = req.ticket.span
+            if sp is not None:
+                sp.end(error=type(exc).__name__)
 
     def _fail_plan(self, plan: BatchPlan, exc: BaseException,
                    stage: str) -> None:
         """Fail exactly this batch's tickets, the stage exception as cause."""
-        self.stats["failed_batches"] += 1
+        self.metrics.inc("engine.failed_batches", key="plan")
         for req in {id(r): r for r, *_ in plan.entries}.values():
             if req.ticket.done:
                 continue
@@ -896,7 +999,7 @@ class Engine:
         every unresolved ticket so no waiter hangs; batches fetched before
         the stall keep their delivered results."""
         self._stalled = True
-        self.stats["stalls"] += 1
+        self.metrics.inc("engine.stalls")
         err = EngineStalledError(
             f"{self._rname} made no progress for {silent:.1f}s after "
             f"{label!r} — wedged backend; in-flight and queued tickets "
@@ -934,12 +1037,15 @@ class Engine:
 
     def health(self) -> dict:
         """Live health snapshot (also rendered into Ticket timeout
-        messages): queue/engine state, failure counters, and realized fault
-        injections by site."""
+        messages): queue/engine state, failure counters (read from the
+        obs metrics registry — this dict is a view, not a second source of
+        truth), and realized fault injections by site. ``last_stage`` /
+        ``stalled_for_s`` name the last pipeline beacon and its age — the
+        structured "where is it stuck" answer a timed-out waiter needs."""
         with self._lock:
             depth = len(self._pending)
             open_n = len(self._open)
-            mark_t, _ = self._last_mark
+            mark_t, mark_label = self._last_mark
         now = time.monotonic()
         s = self.stats
         return {
@@ -949,6 +1055,8 @@ class Engine:
             "max_queue": self.max_queue,
             "uptime_s": now - self._t0,
             "last_progress_s": now - mark_t,
+            "last_stage": mark_label,
+            "stalled_for_s": round(now - mark_t, 3),
             "running": self._running,
             "closed": self._closed,
             "stalled": self._stalled,
@@ -973,11 +1081,12 @@ class Engine:
         rows — padding is excluded from img/s by construction). Failures
         never escape a batch: see the module docstring's isolation story."""
         t0 = time.perf_counter()
-        compiles0 = self.stats["compiles"]
-        counters0 = {k: self.stats[k] for k in
+        s0 = self.stats
+        compiles0 = s0["compiles"]
+        counters0 = {k: s0[k] for k in
                      ("retries", "failed_tickets", "quarantined")}
         rows = padded = batches = 0
-        n_lat0 = len(self.stats["latencies_s"])
+        n_lat0 = self.metrics.count("engine.latency_s")
         self._stalled = False
         self._running = True
         self._idle.clear()
@@ -1004,7 +1113,13 @@ class Engine:
                 if not live:
                     continue
                 self._mark(f"plan {len(live)} requests")
+                tp = spans.now() if spans.enabled() else 0.0
                 plans = plan_batches(live, self.buckets)
+                if spans.enabled():
+                    tp1 = spans.now()
+                    for req in live:
+                        spans.record(req.ticket.span, "plan", tp, tp1,
+                                     batches=len(plans))
                 inflight: deque = deque()
                 for plan, xs, err in device_prefetch(
                         plans, self._assemble_safe,
@@ -1030,7 +1145,8 @@ class Engine:
                 self._wd = None
             self._idle.set()
         wall = time.perf_counter() - t0
-        completed = self.stats["latencies_s"][n_lat0:]
+        s1 = self.stats
+        completed = self.metrics.samples("engine.latency_s")[n_lat0:]
         return {
             "batches": batches,
             "rows": rows,
@@ -1038,10 +1154,10 @@ class Engine:
             "wall_s": wall,
             "img_per_sec": rows / wall if wall > 0 else 0.0,
             "latency": latency_summary(completed),
-            "compiles": self.stats["compiles"] - compiles0,
-            "max_queue_depth": self.stats["max_queue_depth"],
+            "compiles": s1["compiles"] - compiles0,
+            "max_queue_depth": s1["max_queue_depth"],
             "stalled": self._stalled,
-            **{k: self.stats[k] - v0 for k, v0 in counters0.items()},
+            **{k: s1[k] - v0 for k, v0 in counters0.items()},
         }
 
     def _admit(self, pending) -> list:
@@ -1051,7 +1167,7 @@ class Engine:
         live = []
         for req in pending:
             if req.deadline is not None and now > req.deadline:
-                self.stats["deadline_expired"] += 1
+                self.metrics.inc("engine.deadline_expired", key="plan")
                 self._fail_request(req, DeadlineExceeded(
                     f"request {req.rid} missed its deadline while queued "
                     f"on {self._rname} (expired {now - req.deadline:.3f}s "
@@ -1071,6 +1187,16 @@ def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig,
         cache_mode=config.cache_mode,
         cache_threshold=config.cache_threshold,
         cache_tokens=config.cache_tokens or None, sequence=seq).compile()
+
+
+def _ddim_cached_tel_lower(model, params, x, key, cache,
+                           config: SamplerConfig):
+    return sampling._ddim_scan_cached_tel.lower(
+        model, params, x, key, cache, k=config.k, t_start=config.t_start,
+        eta=0.0, cache_interval=config.cache_interval,
+        cache_mode=config.cache_mode,
+        cache_threshold=config.cache_threshold,
+        cache_tokens=config.cache_tokens or None).compile()
 
 
 def _cold_cached_lower(model, params, x, cache, config: SamplerConfig,

@@ -33,6 +33,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional, Sequence
 
+from ddim_cold_tpu.obs import metrics
+
 #: replica lifecycle states (a handle only ever moves forward through these)
 NEW, READY, DRAINING, CLOSED = "new", "ready", "draining", "closed"
 
@@ -88,7 +90,8 @@ class LocalReplica(ReplicaHandle):
     def __init__(self, engine, *, poll_s: float = 0.02, join_s: float = 5.0):
         self.engine = engine
         self.replica_id = engine.replica_id
-        self.state = NEW
+        self.metrics = metrics.scope("fleet")
+        self._set_state(NEW)
         self.poll_s = float(poll_s)
         self.join_s = float(join_s)
         self.warmup_compiles = 0
@@ -99,12 +102,19 @@ class LocalReplica(ReplicaHandle):
 
     # ------------------------------------------------------------ lifecycle
 
+    def _set_state(self, state: str) -> None:
+        """The one state-write site: every lifecycle transition lands in the
+        obs registry keyed by the state entered, so a chaos run's replica
+        churn is countable without scraping router internals."""
+        self.state = state
+        self.metrics.inc("fleet.replica_transitions", key=state)
+
     def warm(self, configs, buckets=None, **kwargs) -> dict:
         from ddim_cold_tpu.serve.warmup import warmup
 
         report = warmup(self.engine, configs, buckets, **kwargs)
         self.warmup_compiles = self.engine.stats["compiles"]
-        self.state = READY
+        self._set_state(READY)
         return report
 
     def start(self) -> None:
@@ -129,7 +139,7 @@ class LocalReplica(ReplicaHandle):
                     pass
 
     def drain(self, timeout: Optional[float] = None) -> dict:
-        self.state = DRAINING
+        self._set_state(DRAINING)
         report = self.engine.drain(timeout)
         self._stop.set()
         self._work.set()
@@ -138,7 +148,7 @@ class LocalReplica(ReplicaHandle):
             # bounded join: a wedged engine (report["idle"] False) can pin
             # the worker forever — it is a daemon thread, leave it behind
             thread.join(self.join_s)
-        self.state = CLOSED
+        self._set_state(CLOSED)
         return report
 
     def close(self) -> None:

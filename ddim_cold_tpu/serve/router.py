@@ -70,6 +70,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ddim_cold_tpu.obs import metrics, spans
 from ddim_cold_tpu.serve import fleet
 from ddim_cold_tpu.serve.batching import SamplerConfig, Ticket
 from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
@@ -97,6 +98,10 @@ class _FleetRequest:
     tried: set = field(default_factory=set)
     placed_on: Optional[str] = None
     resolved: bool = False
+    #: obs root span of this request's trace (None with tracing disabled).
+    #: Every placement attempt — hedges included — is a child of it, so the
+    #: whole multi-replica life of the request shares ONE trace_id.
+    span: object = None
 
 
 class Router:
@@ -162,17 +167,35 @@ class Router:
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats = {"submitted": 0, "completed": 0, "failed": 0,
-                      "rejected": 0, "rejected_by_tenant": {},
-                      "placements": 0, "hedges": 0, "failovers": 0,
-                      "replicas_spawned": 0, "replicas_retired": 0,
-                      "spawn_failures": 0, "loop_errors": 0}
+        #: obs emit handle (``router#N``): the counters the hand-rolled
+        #: stats dict used to hold now live in the process metrics registry
+        #: (obs/metrics.py); :attr:`stats` is a read-only view over it.
+        self.metrics = metrics.scope("router")
         # the initial fleet: a spawn failure here is fatal (chaos specs
         # targeting replica.spawn at cold start surface immediately)
         for _ in range(self._target):
             self._spawn_replica()
         if auto_start:
             self.start()
+
+    @property
+    def stats(self) -> dict:
+        """Legacy router counters, rendered from the metrics registry."""
+        m = self.metrics
+        return {
+            "submitted": m.value("router.submitted"),
+            "completed": m.value("router.completed"),
+            "failed": m.value("router.failed"),
+            "rejected": m.value("router.rejected"),
+            "rejected_by_tenant": m.by_key("router.rejected_by_tenant"),
+            "placements": m.value("router.placements"),
+            "hedges": m.value("router.hedges"),
+            "failovers": m.value("router.failovers"),
+            "replicas_spawned": m.value("router.replicas_spawned"),
+            "replicas_retired": m.value("router.replicas_retired"),
+            "spawn_failures": m.value("router.spawn_failures"),
+            "loop_errors": m.value("router.loop_errors"),
+        }
 
     # -------------------------------------------------------------- replicas
 
@@ -190,7 +213,10 @@ class Router:
         rep.start()
         with self._lock:
             self._replicas[rid] = rep
-        self.stats["replicas_spawned"] += 1
+        self.metrics.inc("router.replicas_spawned")
+        # replica lifetime span: its own trace, closed at retirement — a
+        # chaos run's trace export shows exactly when each replica lived
+        rep._obs_span = spans.begin("replica.lifetime", replica=rid) or None
         return rep
 
     def _retire(self, rid: str, rep) -> None:
@@ -201,7 +227,10 @@ class Router:
         with self._lock:
             self._replicas.pop(rid, None)
             self._retired.append(rep)
-        self.stats["replicas_retired"] += 1
+        self.metrics.inc("router.replicas_retired")
+        sp = getattr(rep, "_obs_span", None)
+        if sp is not None:
+            sp.end(retired=True)
         try:
             rep.drain(self.drain_timeout_s)
         except Exception:  # noqa: BLE001 — a broken drain must not stop
@@ -238,7 +267,7 @@ class Router:
                 self._spawn_replica()
             except Exception:  # noqa: BLE001 — injected or real spawn
                 # failure: count it, retry on the next tick
-                self.stats["spawn_failures"] += 1
+                self.metrics.inc("router.spawn_failures")
                 return
 
     # -------------------------------------------------------------- admission
@@ -325,9 +354,8 @@ class Router:
                 cur = self._outstanding.get(tenant, 0)
                 total = sum(self._outstanding.values())
                 if cur >= share or total >= self.max_pending:
-                    self.stats["rejected"] += 1
-                    per = self.stats["rejected_by_tenant"]
-                    per[tenant] = per.get(tenant, 0) + 1
+                    self.metrics.inc("router.rejected")
+                    self.metrics.inc("router.rejected_by_tenant", key=tenant)
                     raise QueueFullError(
                         f"tenant {tenant!r} at its fair share "
                         f"({cur}/{share} of max_pending={self.max_pending}, "
@@ -339,9 +367,15 @@ class Router:
                                  priority=int(priority), call=call,
                                  deadline=deadline, ticket=ticket)
             self._next_fid += 1
+            if spans.enabled():
+                # ONE trace per fleet request: every placement attempt —
+                # hedges and failovers included — is a child of this span
+                freq.span = spans.begin("router.request", fid=freq.fid,
+                                        tenant=tenant, n=n) or None
+                ticket.span = freq.span
             self._enqueue(freq)
             self._outstanding[tenant] = self._outstanding.get(tenant, 0) + 1
-        self.stats["submitted"] += 1
+        self.metrics.inc("router.submitted")
         self._kick.set()
         return ticket
 
@@ -407,12 +441,24 @@ class Router:
             if freq.deadline is not None:
                 deadline_s = max(0.0,
                                  freq.deadline - time.perf_counter())
+            # per-attempt child span: the replica's engine parents ITS
+            # request span under this ctx, so a hedged ticket's attempts
+            # share one trace across replicas (freq.call stays untouched —
+            # hedges re-issue it verbatim)
+            att = (freq.span.child("router.attempt", replica=rid)
+                   if freq.span is not None else None)
             try:
-                t = rep.submit(deadline_s=deadline_s, **freq.call)
+                t = rep.submit(deadline_s=deadline_s,
+                               trace=att.ctx if att is not None else None,
+                               **freq.call)
             except (QueueFullError, EngineClosedError):
+                if att is not None:
+                    att.end(outcome="backpressure")
                 continue  # replica-level backpressure: next candidate
             except Exception as exc:  # noqa: BLE001 — a replica whose
                 # submit breaks outright cannot hold the request
+                if att is not None:
+                    att.end(outcome="submit_error")
                 err = RequestFailedError(
                     f"replica {rid!r} rejected request {freq.fid}: {exc!r}")
                 err.__cause__ = exc
@@ -420,7 +466,7 @@ class Router:
                 return True
             freq.tried.add(rid)
             freq.placed_on = rid
-            self.stats["placements"] += 1
+            self.metrics.inc("router.placements")
             if freq.call["config"].preview_every:
                 # forward completed replica frames to the router ticket;
                 # its per-step dedupe absorbs a hedge's re-delivery
@@ -428,7 +474,7 @@ class Router:
                     lambda step, frames, f=freq:
                         f.ticket._preview(step, 0, f.n, frames))
             t.add_done_callback(
-                lambda t_, f=freq, r=rid: self._on_ticket(f, r, t_))
+                lambda t_, f=freq, r=rid, a=att: self._on_ticket(f, r, t_, a))
             return True
         return False  # no healthy candidate right now: stay queued
 
@@ -459,15 +505,20 @@ class Router:
 
     # ---------------------------------------------------- outcome handling
 
-    def _on_ticket(self, freq: _FleetRequest, rid: str, t: Ticket) -> None:
+    def _on_ticket(self, freq: _FleetRequest, rid: str, t: Ticket,
+                   att=None) -> None:
         """Done-callback of a placed engine ticket (runs on the replica's
         worker thread — keep it cheap: deliveries resolve inline, failures
         queue an event for the control thread's hedging logic)."""
         if t.failed:
+            if att is not None:
+                att.end(outcome="failed")
             with self._lock:
                 self._events.append((freq, rid, t.exception(0)))
             self._kick.set()
             return
+        if att is not None:
+            att.end(outcome="completed")
         self._complete(freq, t.result(0))
 
     def _complete(self, freq: _FleetRequest, rows) -> None:
@@ -477,7 +528,9 @@ class Router:
             freq.resolved = True
             self._outstanding[freq.tenant] -= 1
         if freq.ticket._deliver(0, freq.n, rows):
-            self.stats["completed"] += 1
+            self.metrics.inc("router.completed")
+            if freq.span is not None:
+                freq.span.end(hedges=freq.hedges, failovers=freq.failovers)
 
     def _fail_freq(self, freq: _FleetRequest, exc: BaseException) -> None:
         with self._lock:
@@ -486,7 +539,10 @@ class Router:
             freq.resolved = True
             self._outstanding[freq.tenant] -= 1
         if freq.ticket._fail(exc):
-            self.stats["failed"] += 1
+            self.metrics.inc("router.failed")
+            if freq.span is not None:
+                freq.span.end(error=type(exc).__name__,
+                              hedges=freq.hedges, failovers=freq.failovers)
 
     def _drain_events(self) -> None:
         while True:
@@ -515,11 +571,11 @@ class Router:
         if retryable and freq.hedges < self.max_hedges:
             kind = "hedge"
             freq.hedges += 1
-            self.stats["hedges"] += 1
+            self.metrics.inc("router.hedges")
         elif evicted and freq.failovers < self.max_failovers:
             kind = "failover"
             freq.failovers += 1
-            self.stats["failovers"] += 1
+            self.metrics.inc("router.failovers")
         else:
             self._fail_freq(freq, exc)
             return
@@ -566,7 +622,7 @@ class Router:
                 self._place_round()
             except Exception:  # noqa: BLE001 — the control loop must
                 # survive anything; a dead loop would strand every ticket
-                self.stats["loop_errors"] += 1
+                self.metrics.inc("router.loop_errors")
 
     # ------------------------------------------------------------- shutdown
 
@@ -602,6 +658,9 @@ class Router:
                 rep.drain(self.drain_timeout_s)
             except Exception:  # noqa: BLE001 — best-effort shutdown
                 pass
+            sp = getattr(rep, "_obs_span", None)
+            if sp is not None:
+                sp.end(retired=False)
         # replica drains may have produced final failure events; with the
         # fleet closed, _handle_failure fails them through typed
         self._drain_events()

@@ -68,6 +68,10 @@ def warmup(engine, configs: Sequence[SamplerConfig],
                 if not tolerate_errors:
                     raise
                 errors[(config, bucket)] = exc
+    m = getattr(engine, "metrics", None)
+    if m is not None:
+        m.inc("warmup.new_compiles", engine.stats["compiles"] - before)
+        m.gauge("warmup.programs", len(engine._programs))
     return {
         "new_compiles": engine.stats["compiles"] - before,
         "programs": len(engine._programs),

@@ -44,7 +44,13 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ddim_cold_tpu.obs import metrics as _obs_metrics
+
 ENV_VAR = "DDIM_COLD_FAULTS"
+
+#: realized injections land in the obs registry keyed by site, so a chaos
+#: run's fault pressure shows up next to the serving counters it perturbs
+_METRICS = _obs_metrics.scope("faults")
 
 #: the named fault sites (typo guard for specs; ``fire`` itself accepts any
 #: string so a site can be added where it is fired before it is listed here)
@@ -286,6 +292,8 @@ def _fire(site: str, tag: str, payload):
                 detail = {"index": idx}
             plan._record(site, call, tag, spec, detail)
             fired.append((spec, call))
+    if fired:
+        _METRICS.inc("faults.injected", len(fired), key=site)
     for spec, _ in fired:
         if spec.kind == "latency":
             time.sleep(spec.latency_s)

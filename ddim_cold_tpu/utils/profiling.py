@@ -40,6 +40,30 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def scope(name: str):
+    """Named scope INSIDE traced code (``jax.named_scope``) — the compiled
+    sibling of :func:`annotate`: the name lands on the ops themselves, so
+    profiler timelines attribute kernel time to sampler stages
+    (``ddim/model``, ``flash_attention/fwd``, ``sp/all_to_all``, …).
+    Metadata-only: the printed jaxpr and its J006 signature hash are
+    untouched, and numerics are bit-identical with or without it."""
+    return jax.named_scope(name)
+
+
+def span_trace(log_dir: str, span=None):
+    """A ``jax.profiler`` trace session keyed to an obs span: the capture
+    lands in ``log_dir/trace_<trace_id>_<span_id>`` (or ``log_dir`` when no
+    span / tracing disabled), so a slow request's profiler timeline is
+    findable from its span ids — the span→profiler workflow for the MFU
+    push (PERF.md "Observability")."""
+    import os
+
+    ctx = getattr(span, "ctx", None)
+    if ctx is not None:
+        log_dir = os.path.join(log_dir, f"trace_{ctx.trace_id}_{ctx.span_id}")
+    return jax.profiler.trace(log_dir)
+
+
 def enable_nan_checks(enable: bool = True) -> None:
     """Re-run suspect computations de-optimized and raise at NaN origin."""
     jax.config.update("jax_debug_nans", enable)
@@ -50,11 +74,14 @@ def latency_summary(samples_s) -> dict:
     engine's per-request report (bench --serving, serve.Engine.stats)."""
     arr = np.asarray(list(samples_s), dtype=np.float64)
     if arr.size == 0:
-        return {"n": 0, "p50_s": 0.0, "p95_s": 0.0, "mean_s": 0.0, "max_s": 0.0}
+        return {"n": 0, "count": 0, "p50_s": 0.0, "p95_s": 0.0, "p99_s": 0.0,
+                "mean_s": 0.0, "max_s": 0.0}
     return {
         "n": int(arr.size),
+        "count": int(arr.size),  # explicit alias: dashboards key on "count"
         "p50_s": float(np.percentile(arr, 50)),
         "p95_s": float(np.percentile(arr, 95)),
+        "p99_s": float(np.percentile(arr, 99)),
         "mean_s": float(arr.mean()),
         "max_s": float(arr.max()),
     }

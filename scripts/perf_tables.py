@@ -130,8 +130,12 @@ def render(path: str) -> str:
         lines.append(
             f"**serving:** {srv.get('img_per_sec')} img/s "
             f"({srv.get('vs_oneshot')}× one-shot) · p50 "
-            f"{srv.get('p50_latency_s')}s / p95 {srv.get('p95_latency_s')}s · "
-            f"compiles after warmup {srv.get('compiles_after_warmup')}")
+            f"{srv.get('p50_latency_s')}s / p95 {srv.get('p95_latency_s')}s"
+            + (f" / p99 {srv['p99_latency_s']}s"
+               if srv.get("p99_latency_s") is not None else "")
+            + (f" over {srv['requests']} requests"
+               if srv.get("requests") else "")
+            + f" · compiles after warmup {srv.get('compiles_after_warmup')}")
         sq = srv.get("quant")
         if sq:
             lines.append(
@@ -209,6 +213,21 @@ def render(path: str) -> str:
                 f"({pv.get('first_frame_fraction')}× wall) · "
                 f"{pv.get('frames')} frames")
 
+    ob = sub.get("obs")
+    if ob:
+        tel = ob.get("telemetry", {})
+        lines.append("")
+        lines.append(
+            f"**observability:** tracing overhead "
+            f"{ob.get('tracing_overhead_pct')}% "
+            f"({ob.get('img_per_sec_tracing_off')} img/s off → "
+            f"{ob.get('img_per_sec_tracing_on')} on) · traced bitwise "
+            f"{ob.get('traced_bitwise_equal')} · {ob.get('spans_recorded')} "
+            f"spans / {ob.get('chrome_events')} chrome events · step "
+            f"telemetry {tel.get('refreshes')}r/{tel.get('reuses')}c "
+            f"(ratio {tel.get('refresh_ratio')}) · compiles after warmup "
+            f"{ob.get('compiles_after_warmup')}")
+
     pl = sub.get("parallel")
     if pl and not pl.get("skipped"):
         degs = pl.get("degrees", {})
@@ -218,6 +237,8 @@ def render(path: str) -> str:
             f"bucket={pl.get('bucket')}, {pl.get('devices')} devices):** "
             + " · ".join(
                 f"sp{d}={leg.get('latency_s')}s"
+                + (f" (p99 {leg['p99_latency_s']}s)"
+                   if leg.get("p99_latency_s") is not None else "")
                 + (f" ({leg.get('speedup_vs_sp1')}× sp1, "
                    f"{leg.get('sp_mode')})" if d != "1" else "")
                 for d, leg in degs.items())

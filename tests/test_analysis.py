@@ -353,7 +353,7 @@ def test_rule_table_covers_all_emitted_rules():
     assert set(RULES) == {
         "GRAFT-J001", "GRAFT-J002", "GRAFT-J003", "GRAFT-J004", "GRAFT-J005",
         "GRAFT-J006", "GRAFT-J007", "GRAFT-A001", "GRAFT-A002", "GRAFT-A003",
-        "GRAFT-A004", "GRAFT-S001", "GRAFT-S002"}
+        "GRAFT-A004", "GRAFT-A005", "GRAFT-S001", "GRAFT-S002"}
 
 
 # ------------------------------------------------------------- clean tree
