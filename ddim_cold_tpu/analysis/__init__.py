@@ -7,13 +7,17 @@ package checks them *statically* on every commit by abstractly tracing the
 real entry points (no device, no params materialized) and linting the host
 code for the repo-specific hazards:
 
-* :mod:`.jaxpr_checks` + :mod:`.entries` — GRAFT-J001..J006 over traced
+* :mod:`.jaxpr_checks` + :mod:`.entries` — GRAFT-J001..J007 over traced
   jaxprs, AOT donation metadata, and the serve-sweep signature hash.
-* :mod:`.ast_checks` — GRAFT-A001..A004 source lint.
+* :mod:`.ast_checks` — GRAFT-A001..A005 source lint.
 * :mod:`.sharding_checks` — GRAFT-S001/S002 param-tree spec coverage.
+* :mod:`.thread_checks` — GRAFT-T001..T005 lockset/lock-order analysis of
+  the threaded host serving layer (``# guarded-by:`` annotation grammar).
+* :mod:`.collective_checks` — GRAFT-C001/C002 collective-order deadlock
+  proofs over the serve sweep's cached traces (multi-axis mesh programs).
 * :mod:`.cli` — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``;
   nonzero exit on non-baselined findings; ``--fix-baseline`` regenerates
-  the reviewed allowlist.
+  the reviewed allowlist (``--only`` limits it to selected rule families).
 
 This module stays import-light (no jax) so the CLI can pin the platform
 before tracing.

@@ -1,14 +1,23 @@
 """graftcheck CLI — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``.
 
-Runs the three layers (AST lint, jaxpr entry checks + serve-signature
-sweep, sharding coverage), subtracts the reviewed ``--baseline`` allowlist,
-prints the rest and exits nonzero if any remain. ``--fix-baseline``
-regenerates the allowlist deterministically instead (sorted, deduped) so
-its diffs review cleanly.
+Runs the five layers (AST lint, thread-safety lockset analysis, jaxpr
+entry checks + serve-signature sweep, collective-order proofs over the
+sweep's traces, sharding coverage), subtracts the reviewed ``--baseline``
+allowlist, prints the rest and exits nonzero if any remain.
+``--fix-baseline`` regenerates the allowlist deterministically instead
+(sorted, deduped) so its diffs review cleanly; combined with ``--only`` it
+refreshes ONLY the selected layers' rule families, preserving the other
+layers' reviewed lines verbatim.
 
-The jaxpr layer traces real model code, so the CLI pins jax to CPU before
-any trace (the check is backend-independent — it never executes a program)
-unless ``--platform`` says otherwise.
+``--only`` takes layer names or rule-family letters, comma-separable:
+``--only T,C`` ≡ ``--only threads --only collective`` — the fast host-side
+path CI runs without paying for a trace sweep.
+
+The jaxpr/collective layers trace real model code, so the CLI pins jax to
+CPU before any trace (the check is backend-independent — it never executes
+a program) unless ``--platform`` says otherwise. The collective layer
+reuses the jaxpr layer's sweep traces when both run — the sweep is traced
+once either way.
 """
 
 from __future__ import annotations
@@ -19,7 +28,31 @@ import sys
 
 from ddim_cold_tpu.analysis import findings as F
 
-LAYERS = ("ast", "jaxpr", "sharding")
+LAYERS = ("ast", "jaxpr", "sharding", "threads", "collective")
+
+#: rule-family letters accepted by --only as layer aliases (--only T,C)
+_ONLY_ALIASES = {"a": "ast", "j": "jaxpr", "s": "sharding",
+                 "t": "threads", "c": "collective"}
+
+
+def parse_only(values) -> tuple:
+    """Normalize repeatable/comma-separated ``--only`` tokens (layer names
+    or family letters, any case) into an ordered layer tuple."""
+    out = []
+    for value in values:
+        for tok in value.split(","):
+            tok = tok.strip().lower()
+            if not tok:
+                continue
+            layer = _ONLY_ALIASES.get(tok, tok)
+            if layer not in LAYERS:
+                raise argparse.ArgumentTypeError(
+                    f"unknown layer {tok!r} (choose from "
+                    f"{', '.join(LAYERS)} or letters "
+                    f"{', '.join(sorted(_ONLY_ALIASES))})")
+            if layer not in out:
+                out.append(layer)
+    return tuple(out)
 
 
 def repo_root() -> str:
@@ -36,11 +69,26 @@ def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
         from ddim_cold_tpu.analysis import ast_checks
 
         out += ast_checks.lint_tree(root)
+    if "threads" in only:
+        from ddim_cold_tpu.analysis import thread_checks
+
+        out += thread_checks.lint_tree(root)
+    # the collective layer consumes the jaxpr layer's sweep traces when
+    # both run (one sweep trace either way); alone, it traces one world
+    traces = {} if "collective" in only else None
     if "jaxpr" in only:
         from ddim_cold_tpu.analysis import entries
 
         out += entries.run_entry_checks(max_const_bytes=max_const_bytes)
-        out += entries.run_serve_signature_check()
+        out += entries.run_serve_signature_check(traces=traces)
+    elif traces is not None:
+        from ddim_cold_tpu.analysis import entries
+
+        entries.serve_signatures(entries.Context(), traces=traces)
+    if traces is not None:
+        from ddim_cold_tpu.analysis import collective_checks
+
+        out += collective_checks.check_serve_collectives(traces)
     if "sharding" in only:
         from ddim_cold_tpu.analysis import sharding_checks
 
@@ -59,9 +107,14 @@ def main(argv=None) -> int:
                          "the run (missing file = empty baseline)")
     ap.add_argument("--fix-baseline", default=None, metavar="FILE",
                     help="write the current findings as the new baseline "
-                         "and exit 0")
-    ap.add_argument("--only", action="append", choices=LAYERS, default=None,
-                    help="run a subset of layers (repeatable)")
+                         "and exit 0; with --only, refresh ONLY the "
+                         "selected layers' rule families and keep the "
+                         "file's other lines verbatim")
+    ap.add_argument("--only", action="append", default=None,
+                    metavar="LAYER[,LAYER...]",
+                    help="run a subset of layers (repeatable or "
+                         "comma-separated; layer names or rule-family "
+                         "letters: --only T,C)")
     ap.add_argument("--max-const-bytes", type=int, default=1 << 20,
                     help="GRAFT-J004 threshold (default 1 MiB)")
     ap.add_argument("--platform", default="cpu",
@@ -82,14 +135,26 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", args.platform)
 
-    only = tuple(args.only) if args.only else LAYERS
+    try:
+        only = parse_only(args.only) if args.only else LAYERS
+    except argparse.ArgumentTypeError as e:
+        ap.error(str(e))
     all_findings = collect(args.root, only=only,
                            max_const_bytes=args.max_const_bytes)
 
     if args.fix_baseline:
-        n = F.write_baseline(args.fix_baseline, all_findings)
+        extra: set[str] = set()
+        if args.only:
+            # partial refresh: the layers we did NOT run stay authoritative
+            # in the existing file — carry their lines over verbatim so
+            # adopting one rule family never churns the others' entries
+            extra = {k for k in F.load_baseline(args.fix_baseline)
+                     if F.rule_layer(k.split(" ", 1)[0]) not in only}
+        n = F.write_baseline(args.fix_baseline, all_findings,
+                             extra_keys=extra)
+        kept = f" ({len(extra)} kept from other layers)" if extra else ""
         print(f"graftcheck: wrote {n} baseline entr"
-              f"{'y' if n == 1 else 'ies'} to {args.fix_baseline}")
+              f"{'y' if n == 1 else 'ies'} to {args.fix_baseline}{kept}")
         return 0
 
     baseline = F.load_baseline(args.baseline)

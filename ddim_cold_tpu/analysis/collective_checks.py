@@ -1,0 +1,217 @@
+"""GRAFT-C001/C002 — collective-order deadlock proofs for mesh programs.
+
+A multi-axis (sequence-parallel, and eventually pipeline-parallel) sampler
+program is SPMD: one jaxpr, executed by every shard of the mesh. Shards
+deadlock when they disagree about which collective comes next on an axis —
+one shard enters an ``all_to_all`` while its peer entered a ``ppermute``,
+and both wait forever. Because the program is single-source, the ONLY way
+shards can disagree is data-dependent control flow: a collective under a
+``cond``/``switch`` whose predicate can differ per shard, or under a
+``while`` whose trip count can (J007 already bans the latter from served
+programs; this pass re-proves it for the collective case).
+
+**C001** therefore proves per program: along every control-flow path
+*inside the manual (shard_map) region*, the ordered sequence of collective
+primitives per mesh axis is identical — every ``cond``/``switch`` branch
+set has ONE common collective sequence, and no ``while`` body
+communicates. Control flow OUTSIDE the manual region is exempt by
+construction: a ``lax.cond`` predicate is a scalar, scalars are replicated
+under the partitioner, and every device computes it from the same
+replicated values — so all shards take the same branch *together* even
+when the branches' collective counts differ (the adaptive drift gate's
+refresh-vs-reuse ``cond`` wraps the sp attention exactly this way).
+Per-shard values, the only source of divergence, exist only inside
+shard_map. Path-invariance there + single-program SPMD ⇒ every shard
+issues the same collectives in the same order ⇒ the program cannot
+self-deadlock on its mesh. This is the static precondition the ROADMAP's
+pipeline-parallel serving item needs before an sp×pipe program may land
+(see PERF.md).
+
+**C002** proves every collective names an axis its enclosing mesh actually
+defines (and sits inside a mesh at all): an ``all_to_all`` over a
+misspelled or out-of-mesh axis is at best unlowerable and at worst a
+silently wrong program when the axis exists on some OTHER mesh.
+
+The pass walks the J006 serve-sweep traces the signature check already
+built — it re-traces nothing (``graftcheck``'s jaxpr layer hands its
+world-A traces over), keeping the whole run inside the existing CPU
+budget.
+"""
+
+from __future__ import annotations
+
+from ddim_cold_tpu.analysis.findings import Finding
+
+#: the engine owns the serve sweep — C findings anchor where J006's do
+ENGINE_PATH = "ddim_cold_tpu/serve/engine.py"
+
+#: communicating collectives: a rendezvous across shards of the named axis.
+#: (``axis_index`` is deliberately absent — it reads the coordinate without
+#: communicating, so it cannot deadlock.)
+COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_to_all",
+    "all_gather", "all_gather_invariant", "psum_scatter", "reduce_scatter",
+})
+
+_SUB_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr",
+                   "cond_jaxpr", "body_jaxpr")
+
+
+def _axes_of(eqn) -> tuple:
+    """The mesh axis names a collective eqn communicates over, from its
+    params (``axis_name`` for the permute/gather family, ``axes`` for the
+    psum family; ints are positional axes, not mesh axes — dropped)."""
+    raw = eqn.params.get("axis_name", eqn.params.get("axes", ()))
+    if isinstance(raw, (tuple, list, frozenset, set)):
+        axes = tuple(a for a in raw if isinstance(a, str))
+    else:
+        axes = (raw,) if isinstance(raw, str) else ()
+    return axes
+
+
+def _inner(obj):
+    """ClosedJaxpr/Jaxpr → the Jaxpr with eqns."""
+    return obj.jaxpr if hasattr(obj, "jaxpr") else obj
+
+
+class _Walk:
+    """One program's walk state: per-axis event sequences + findings."""
+
+    def __init__(self, subject: str, path: str):
+        self.subject = subject
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def emit(self, rule, tag, msg) -> None:
+        self.findings.append(Finding(
+            rule, self.path, f"{self.subject}:{tag}", 0, msg))
+
+    def events(self, jaxpr, mesh_axes) -> tuple:
+        """The ordered ``(primitive, axis)`` collective sequence of one
+        (sub)jaxpr, emitting C001/C002 along the way. ``mesh_axes`` is the
+        manual axis-name set of the enclosing shard_map, or None outside
+        any mesh."""
+        out: list = []
+        for eqn in _inner(jaxpr).eqns:
+            prim = eqn.primitive.name
+            if prim == "shard_map":
+                mesh = eqn.params.get("mesh")
+                names = tuple(getattr(mesh, "axis_names", ()) or ())
+                manual = frozenset(names) - frozenset(
+                    eqn.params.get("auto", ()) or ())
+                out += self.events(eqn.params["jaxpr"], manual)
+            elif prim in ("cond", "switch"):
+                out += self._branch_events(eqn, mesh_axes)
+            elif prim == "while":
+                for key in ("cond_jaxpr", "body_jaxpr"):
+                    body = self.events(eqn.params[key], mesh_axes)
+                    # inside the manual region the trip count can be
+                    # per-shard — shards would disagree on how many
+                    # rendezvous to issue; outside, it is replicated and
+                    # uniform (same argument as branches, see _branch_events)
+                    if body and mesh_axes is not None:
+                        self.emit(
+                            "GRAFT-C001", f"while:{body[0][0]}",
+                            f"collective {body[0][0]!r} inside a `while` "
+                            f"{key.split('_')[0]} within the manual mesh "
+                            "region — a per-shard trip count lets shards "
+                            "disagree on how many rendezvous to issue "
+                            "(deadlock)")
+                    out += body
+            elif prim in COLLECTIVES:
+                axes = _axes_of(eqn)
+                if not axes:
+                    continue  # axis-free psum (positional reduce) — local
+                for ax in axes:
+                    if mesh_axes is None:
+                        self.emit(
+                            "GRAFT-C002", f"{prim}:{ax}:no-mesh",
+                            f"collective {prim!r} over axis {ax!r} outside "
+                            "any shard_map mesh")
+                    elif ax not in mesh_axes:
+                        self.emit(
+                            "GRAFT-C002", f"{prim}:{ax}",
+                            f"collective {prim!r} names axis {ax!r}, absent "
+                            f"from the program mesh axes "
+                            f"{sorted(mesh_axes)}")
+                    out.append((prim, ax))
+            else:
+                for key in _SUB_JAXPR_KEYS:
+                    sub = eqn.params.get(key)
+                    if sub is None:
+                        continue
+                    subs = sub if isinstance(sub, (tuple, list)) else (sub,)
+                    for s in subs:
+                        # a scan body's sequence repeats a STATIC number of
+                        # times — same order on every shard, so one pass of
+                        # its events stands in for all iterations
+                        out += self.events(s, mesh_axes)
+        return tuple(out)
+
+    def _branch_events(self, eqn, mesh_axes) -> tuple:
+        """cond/switch INSIDE the manual mesh region: every branch must
+        issue the identical collective sequence, else shards whose
+        (per-shard) predicates diverge deadlock — C001. OUTSIDE the manual
+        region the predicate is a replicated scalar: every device computes
+        it from the same replicated values and takes the same branch
+        together, so differing branch sequences are safe (the drift gate's
+        refresh-vs-reuse cond over the sp attention is the in-tree case).
+        The branch set's contribution is the first branch's sequence —
+        exact under the in-region identity proof, and representative under
+        the out-of-region uniform-choice argument."""
+        seqs = [self.events(b, mesh_axes)
+                for b in eqn.params.get("branches", ())]
+        if not seqs:
+            return ()
+        if mesh_axes is not None and any(s != seqs[0] for s in seqs[1:]):
+            shapes = [" ".join(f"{p}@{a}" for p, a in s) or "<none>"
+                      for s in seqs]
+            self.emit(
+                "GRAFT-C001", "cond-divergent",
+                "collective sequence differs across cond/switch branches "
+                f"inside the manual mesh region ({' | '.join(shapes)}) — "
+                "shards whose per-shard predicates diverge rendezvous out "
+                "of order (deadlock)")
+        return seqs[0]
+
+
+def collective_signature(closed, subject: str = "",
+                         path: str = ENGINE_PATH) -> dict:
+    """``{axis: (primitive, ...)}`` — the per-axis collective order of one
+    traced program (tests assert the sp sweep entries' signatures are
+    non-empty, proving the pass actually sees the collectives)."""
+    walk = _Walk(subject, path)
+    sig: dict = {}
+    for prim, ax in walk.events(closed, None):
+        sig.setdefault(ax, []).append(prim)
+    return {ax: tuple(seq) for ax, seq in sig.items()}
+
+
+def check_jaxpr(closed, subject: str,
+                path: str = ENGINE_PATH) -> list[Finding]:
+    """C001 + C002 over one traced program."""
+    walk = _Walk(subject, path)
+    walk.events(closed, None)
+    return walk.findings
+
+
+def check_serve_collectives(traces: dict) -> list[Finding]:
+    """C001/C002 over the J006 sweep's cached traces: ``traces`` maps the
+    J006 subject (``"<label>:b<bucket>"``) to ``(config, closed_jaxpr)`` as
+    built by ``entries.serve_signatures(..., traces=...)`` — the proof
+    reuses those traces instead of re-tracing the sweep."""
+    findings: list[Finding] = []
+    for subject in sorted(traces):
+        _config, closed = traces[subject]
+        findings += check_jaxpr(closed, subject)
+    return findings
+
+
+def run_collective_checks() -> list[Finding]:
+    """Standalone entry (``--only collective`` without the jaxpr layer):
+    builds one world and traces the sweep itself."""
+    from ddim_cold_tpu.analysis import entries
+
+    traces: dict = {}
+    entries.serve_signatures(entries.Context(), traces=traces)
+    return check_serve_collectives(traces)

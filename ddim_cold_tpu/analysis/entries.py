@@ -405,26 +405,31 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
                  dict(k=config.k, t_start=config.t_start, eta=0.0))
 
 
-def serve_signatures(ctx: Context,
-                     findings: list | None = None) -> dict[str, str]:
+def serve_signatures(ctx: Context, findings: list | None = None,
+                     traces: dict | None = None) -> dict[str, str]:
     """``"<label>:b<bucket>" → trace hash`` for the whole warmed sweep.
     When ``findings`` is passed, each trace is also run through the J007
-    static-trip-count check (no extra tracing — the J006 trace is reused)."""
+    static-trip-count check (no extra tracing — the J006 trace is reused).
+    When ``traces`` is passed (a dict), each subject's ``(config,
+    closed_jaxpr)`` is stashed into it — the collective-order pass (C001/
+    C002) consumes this cache instead of re-tracing the sweep, which is
+    what keeps the full graftcheck run inside the CPU budget."""
     out = {}
     for label, config, buckets in serve_sweep():
         for bucket in buckets:
             e = _serve_entry(ctx, config, bucket)
             closed = e.trace()
-            out[f"{label}:b{bucket}"] = jaxpr_checks.signature_hash(
-                closed, e.dyn_args)
+            subject = f"{label}:b{bucket}"
+            out[subject] = jaxpr_checks.signature_hash(closed, e.dyn_args)
             if findings is not None:
                 findings += jaxpr_checks.check_static_trip_count(
-                    closed, f"{label}:b{bucket}",
-                    "ddim_cold_tpu/serve/engine.py")
+                    closed, subject, "ddim_cold_tpu/serve/engine.py")
+            if traces is not None:
+                traces[subject] = (config, closed)
     return out
 
 
-def run_serve_signature_check() -> list[Finding]:
+def run_serve_signature_check(traces: dict | None = None) -> list[Finding]:
     """Trace the warmed sweep twice with independently built model/param
     worlds. Hash instability across worlds = a retrace would MISS the AOT
     executable (a serve-time compile); a hash shared by two distinct
@@ -443,7 +448,7 @@ def run_serve_signature_check() -> list[Finding]:
     the loop structure at run time."""
     PATH = "ddim_cold_tpu/serve/engine.py"
     findings: list[Finding] = []
-    sigs_a = serve_signatures(Context(), findings)
+    sigs_a = serve_signatures(Context(), findings, traces)
     sigs_b = serve_signatures(Context())
     by_hash: dict[str, str] = {}
     for subject, h in sigs_a.items():

@@ -57,7 +57,41 @@ RULES = {
                   "fell through to a replicated spec on a model-axis mesh",
     "GRAFT-S002": "param leaf without a usable PartitionSpec (structure "
                   "mismatch, rank overflow, or unknown mesh axis)",
+    "GRAFT-T001": "shared attribute with a declared `# guarded-by:` lock "
+                  "written (outside __init__) without holding the guard — "
+                  "a data race on the worker-thread/submit path",
+    "GRAFT-T002": "lock acquired while holding a lock of equal or higher "
+                  "rank in the declared hierarchy (router < engine/fleet < "
+                  "batching < obs) — an ordering inversion that can deadlock",
+    "GRAFT-T003": "ticket resolution or user-visible callback invoked while "
+                  "holding a lock — the callback can re-enter the serving "
+                  "layer and deadlock (callbacks must fire outside locks)",
+    "GRAFT-T004": "Event.wait()/Condition.wait() on one synchronizer while "
+                  "holding a different lock — the notifier may need that "
+                  "lock, wedging both threads",
+    "GRAFT-T005": "unguarded lazy-init: check-then-set on a guarded shared "
+                  "attribute without the lock (and without a re-check under "
+                  "it) — double allocation under concurrent first use",
+    "GRAFT-C001": "collective sequence diverges across program shards of "
+                  "one mesh (collective under per-shard control flow "
+                  "inside the manual shard_map region) — an SPMD deadlock; "
+                  "every shard must issue the same collectives in the "
+                  "same order per mesh axis",
+    "GRAFT-C002": "collective over a mesh axis the program's mesh does not "
+                  "define (or outside any mesh) — unlowerable or silently "
+                  "wrong sp program",
 }
+
+#: rule-family letter (GRAFT-<X>NNN) → the CLI layer that emits it. The
+#: partial --fix-baseline (--only) uses this to know which baseline lines a
+#: layer run is authoritative for.
+RULE_LAYERS = {"A": "ast", "J": "jaxpr", "S": "sharding",
+               "T": "threads", "C": "collective"}
+
+
+def rule_layer(rule: str) -> str:
+    """The CLI layer a rule id belongs to (``GRAFT-T001`` → ``threads``)."""
+    return RULE_LAYERS[rule.split("-", 1)[1][0]]
 
 
 @dataclass(frozen=True, order=True)
@@ -100,10 +134,15 @@ def load_baseline(path: str | None) -> set[str]:
     return keys
 
 
-def write_baseline(path: str, findings: list[Finding]) -> int:
+def write_baseline(path: str, findings: list[Finding],
+                   extra_keys: set[str] | frozenset = frozenset()) -> int:
     """Regenerate the allowlist deterministically: header, then the sorted,
-    de-duplicated keys of ``findings`` — reviewed diffs stay minimal."""
-    keys = sorted({f.key for f in findings})
+    de-duplicated keys of ``findings`` — reviewed diffs stay minimal.
+    ``extra_keys`` are preserved verbatim alongside the regenerated keys —
+    the partial refresh (``--fix-baseline --only``) passes the lines of
+    layers it did NOT run, so adopting one rule family never churns the
+    others' reviewed entries."""
+    keys = sorted({f.key for f in findings} | set(extra_keys))
     with open(path, "w") as f:
         f.write("# graftcheck baseline — reviewed allowlist of known "
                 "findings.\n")

@@ -153,7 +153,11 @@ class Scope:
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._series: dict = {}          # (sid, name) -> _Series
+        # (sid, name) -> _Series. The lock guards the dict AND the mutable
+        # innards of every series in it (by_key / samples): emit mutates
+        # them, so every read surface copies them out under the lock too —
+        # a render racing an emit must never iterate a dict mid-resize.
+        self._series: dict = {}                         # guarded-by: _lock
         self._scope_ids = itertools.count()
 
     def scope(self, name: str) -> Scope:
@@ -183,26 +187,42 @@ class Registry:
                 s.samples.append(value)
 
     def _get(self, sid, name) -> Optional[_Series]:
+        """A point-in-time COPY of the series, taken under the lock. The
+        live object's by_key/samples are mutated by concurrent emits; the
+        old code handed the live series out and let Scope readers copy its
+        innards OUTSIDE the lock — a snapshot racing an emit could iterate
+        a dict mid-resize (emit-vs-render consistency, graftcheck T-rules
+        audit)."""
         with self._lock:
-            return self._series.get((sid, name))
+            s = self._series.get((sid, name))
+            if s is None:
+                return None
+            c = _Series(s.kind)
+            c.value = s.value
+            c.by_key = dict(s.by_key)
+            c.samples = list(s.samples)
+            return c
 
     def snapshot(self) -> dict:
         """{scope_id: {name: value | {key: value} | [samples]}} — counters
         render their total (keyed subdivisions under ``name + "/by_key"``),
-        gauges their last value, histograms their raw sample list."""
+        gauges their last value, histograms their raw sample list. Rendered
+        entirely under the lock: the per-series containers it reads are
+        emit-mutable, so the copy and the render must be one atomic view
+        (a snapshot taken mid-request never shows a counter without its
+        by_key breakdown)."""
         out: dict = {}
         with self._lock:
-            items = list(self._series.items())
-        for (sid, name), s in items:
-            dst = out.setdefault(sid, {})
-            if s.kind == "counter":
-                dst[name] = s.total
-                if s.by_key:
-                    dst[name + "/by_key"] = dict(s.by_key)
-            elif s.kind == "gauge":
-                dst[name] = s.value
-            else:
-                dst[name] = list(s.samples)
+            for (sid, name), s in self._series.items():
+                dst = out.setdefault(sid, {})
+                if s.kind == "counter":
+                    dst[name] = s.total
+                    if s.by_key:
+                        dst[name + "/by_key"] = dict(s.by_key)
+                elif s.kind == "gauge":
+                    dst[name] = s.value
+                else:
+                    dst[name] = list(s.samples)
         return out
 
     def reset(self) -> None:

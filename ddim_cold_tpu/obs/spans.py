@@ -134,18 +134,27 @@ class Recorder:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._spans: list = []
+        self._spans: list = []                          # guarded-by: _lock
         self._trace_ids = itertools.count(1)
         self._span_ids = itertools.count(1)
-        self._t0: Optional[float] = None
+        self._t0: Optional[float] = None                # guarded-by: _lock
 
     def now(self) -> float:
+        """Monotonic seconds since the recorder's first event. The epoch is
+        lazily anchored with double-checked locking (the set and its
+        re-check sit under ``_lock`` — graftcheck T005), and the anchored
+        value is read back ONCE under the lock: the old code re-read
+        ``self._t0`` unguarded after the check, so a concurrent ``clear()``
+        could None it mid-call (TypeError) or swap in a newer epoch and
+        skew the timestamp."""
         t = time.monotonic()
-        if self._t0 is None:
+        t0 = self._t0
+        if t0 is None:
             with self._lock:
                 if self._t0 is None:
                     self._t0 = t
-        return t - self._t0
+                t0 = self._t0
+        return t - t0
 
     def begin(self, name: str, parent=None, **attrs) -> Span:
         if isinstance(parent, Span):

@@ -228,11 +228,17 @@ class Ticket:
         self.done_time: Optional[float] = None
         self._lock = threading.Lock()
         self._event = threading.Event()
-        self._buf: Optional[np.ndarray] = None
-        self._remaining = int(n)
-        self._error: Optional[BaseException] = None
+        self._buf: Optional[np.ndarray] = None          # guarded-by: _lock
+        self._remaining = int(n)                        # guarded-by: _lock
+        self._error: Optional[BaseException] = None     # guarded-by: _lock
+        # resolution outcome, decided ATOMICALLY under _lock: True once the
+        # ticket completed or failed. _event trails it (set in _resolve,
+        # outside the lock), so first-resolution-wins races on _resolved,
+        # never on the event — a _fail landing in the window between a
+        # completing _deliver's lock release and its _event.set() must lose.
+        self._resolved = False                          # guarded-by: _lock
         self._health_cb = None  # engine attaches its health snapshot hook
-        self._callbacks: list = []
+        self._callbacks: list = []                      # guarded-by: _lock
         #: obs root span for this request (obs/spans.py) — set by the engine
         #: or router at submit when tracing is enabled, else None
         self.span = None
@@ -246,10 +252,12 @@ class Ticket:
         # double-fired; history keeps frames alive for late previews() /
         # add_preview_callback consumers.
         self._pcond = threading.Condition()
-        self._pbuf: dict = {}       # step -> [frame buffer, rows remaining]
-        self._pdone: set = set()    # completed steps (hedge dedupe)
-        self._phistory: list = []   # completed (step, frames), in order
-        self._preview_cbs: list = []
+        # step -> [frame buffer, rows remaining]
+        self._pbuf: dict = {}                           # guarded-by: _lock
+        self._pdone: set = set()    # hedge dedupe       # guarded-by: _lock
+        # completed (step, frames), in order
+        self._phistory: list = []                       # guarded-by: _pcond
+        self._preview_cbs: list = []                    # guarded-by: _pcond
 
     def add_done_callback(self, fn) -> None:
         """Call ``fn(ticket)`` once, when the ticket resolves (completed OR
@@ -259,7 +267,7 @@ class Ticket:
         router rides this to learn a placement's outcome without a thread
         per ticket."""
         with self._lock:
-            if not self._event.is_set():
+            if not self._resolved:
                 self._callbacks.append(fn)
                 return
         self._run_callback(fn)
@@ -307,7 +315,7 @@ class Ticket:
         re-placement re-delivers the schedule), are dropped."""
         step = int(step)
         with self._lock:
-            if self._error is not None or self._event.is_set():
+            if self._resolved:
                 return False
             if step in self._pdone:
                 return False
@@ -360,13 +368,15 @@ class Ticket:
         Rows landing after the ticket failed are dropped (the error is the
         outcome; a half-filled buffer must never masquerade as a result)."""
         with self._lock:
-            if self._error is not None:
+            if self._resolved:
                 return False
             if self._buf is None:
                 self._buf = np.empty((self.n,) + rows.shape[1:], rows.dtype)
             self._buf[lo:hi] = rows
             self._remaining -= hi - lo
             done = self._remaining == 0
+            if done:
+                self._resolved = True  # claim the resolution under the lock
         if done:
             self._resolve()
         return done
@@ -374,10 +384,15 @@ class Ticket:
     def _fail(self, exc: BaseException) -> bool:
         """Engine-side: resolve the ticket as failed. First resolution wins
         (a ticket that already completed, or already failed, is untouched);
-        returns True when THIS call resolved it."""
+        returns True when THIS call resolved it. The claim races on
+        ``_resolved``, not on ``_event``: a completing ``_deliver`` marks
+        ``_resolved`` before releasing the lock but sets the event only
+        afterwards, so testing the event here would let a concurrent
+        ``_fail`` mask a fully delivered result with an error."""
         with self._lock:
-            if self._event.is_set() or self._error is not None:
+            if self._resolved:
                 return False
+            self._resolved = True
             self._error = exc
         self._resolve()
         return True

@@ -204,11 +204,12 @@ class Engine:
         # (≈4× fewer trunk-param bytes over the link than the float tree).
         self._qparams = None
         self._quant_models: dict = {}  # quant mode -> model clone (hash key)
-        self._pending: list[Request] = []
-        self._open: dict = {}  # rid -> unresolved Request (stall fail set)
+        self._pending: list[Request] = []               # guarded-by: _lock
+        # rid -> unresolved Request (stall fail set)
+        self._open: dict = {}                           # guarded-by: _lock
         self._lock = threading.Lock()
-        self._next_rid = 0
-        self._closed = False
+        self._next_rid = 0                              # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
         self._stalled = False
         self._running = False
         self._wd: Optional[StallWatchdog] = None
@@ -1020,7 +1021,15 @@ class Engine:
         requests while their batches are on the device would race delivery
         and could resolve a ticket the pipeline is about to complete. The
         caller decides: wait again, or escalate (the fleet router treats a
-        non-idle drain as a wedged replica)."""
+        non-idle drain as a wedged replica).
+
+        Idle-race audit (graftcheck T-rules): this sweep cannot double-fail
+        or lose a request even when a :meth:`run` starts concurrently —
+        both sides take the queue by SWAPPING ``_pending`` under ``_lock``
+        (each request appears in exactly one swap), ``submit`` rejects once
+        ``_closed`` is set under the same lock (nothing lands after either
+        sweep), and a run() racing the idle wait fails its own swapped list
+        through the same first-resolution-wins ``Ticket._fail`` path."""
         with self._lock:
             self._closed = True
         idle = self._idle.wait(timeout)

@@ -97,7 +97,7 @@ class LocalReplica(ReplicaHandle):
         self.warmup_compiles = 0
         self._work = threading.Event()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle

@@ -154,16 +154,21 @@ class Router:
         self.tick_s = float(tick_s)
         self._warm_kwargs = dict(warm_kwargs or {})
         self._lock = threading.RLock()
-        self._replicas: dict = {}      # rid -> active ReplicaHandle
-        self._retired: list = []       # drained handles (health still summed)
+        # rid -> active ReplicaHandle
+        self._replicas: dict = {}                       # guarded-by: _lock
+        # drained handles (health still summed)
+        self._retired: list = []                        # guarded-by: _lock
         self._target = int(replicas)
-        self._queues: dict = {}        # tenant -> heap of (-prio, seq, freq)
-        self._outstanding: dict = {}   # tenant -> admitted-unresolved count
-        self._events: deque = deque()  # (freq, rid, exc) failure reports
+        # tenant -> heap of (-prio, seq, freq)
+        self._queues: dict = {}                         # guarded-by: _lock
+        # tenant -> admitted-unresolved count
+        self._outstanding: dict = {}                    # guarded-by: _lock
+        # (freq, rid, exc) failure reports
+        self._events: deque = deque()                   # guarded-by: _lock
         self._seq = itertools.count()
-        self._next_fid = 0
-        self._next_rep = 0
-        self._closed = False
+        self._next_fid = 0                              # guarded-by: _lock
+        self._next_rep = 0                              # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -379,7 +384,7 @@ class Router:
         self._kick.set()
         return ticket
 
-    def _enqueue(self, freq: _FleetRequest) -> None:
+    def _enqueue(self, freq: _FleetRequest) -> None:  # requires: _lock
         heapq.heappush(self._queues.setdefault(freq.tenant, []),
                        (-freq.priority, next(self._seq), freq))
 

@@ -171,10 +171,10 @@ class _Armed:
 
 
 _lock = threading.RLock()
-_armed: list = []
-_calls: dict = {}
-_plan: Optional[FaultPlan] = None
-_env_checked = False
+_armed: list = []                                       # guarded-by: _lock
+_calls: dict = {}                                       # guarded-by: _lock
+_plan: Optional[FaultPlan] = None                       # guarded-by: _lock
+_env_checked = False                                    # guarded-by: _lock
 
 
 def active() -> bool:

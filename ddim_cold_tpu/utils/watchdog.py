@@ -51,8 +51,8 @@ class StallWatchdog:
         self.exit_code = exit_code
         self.on_abort = on_abort
         self.name = name
-        self._state = {"t": time.time(), "label": "start", "budget": None,
-                       "done": False}
+        self._state = {"t": time.time(), "label": "start",  # guarded-by: _lock
+                       "budget": None, "done": False}
         self._lock = threading.Lock()
 
     def mark(self, label: str, budget_s: Optional[float] = None) -> None:

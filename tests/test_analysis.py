@@ -15,9 +15,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ddim_cold_tpu.analysis import ast_checks, cli, entries, jaxpr_checks
-from ddim_cold_tpu.analysis import sharding_checks
-from ddim_cold_tpu.analysis.findings import RULES, Finding, load_baseline, write_baseline
+from ddim_cold_tpu.analysis import ast_checks, cli, collective_checks, entries
+from ddim_cold_tpu.analysis import jaxpr_checks, sharding_checks, thread_checks
+from ddim_cold_tpu.analysis.findings import (
+    RULES, Finding, load_baseline, rule_layer, write_baseline)
 
 SITES = ("serve.assemble", "ckpt.save")  # a registry slice for lint fixtures
 
@@ -316,6 +317,388 @@ def test_s002_structure_mismatch():
     assert [(f.rule, f.subject) for f in fs] == [("GRAFT-S002", "t:b")]
 
 
+# ---------------------------------------------------------- thread rules
+
+
+def _tlint(src, lock_ranks=None):
+    return thread_checks.lint_source(
+        textwrap.dedent(src), "fix.py", lock_ranks=lock_ranks)
+
+
+def test_t001_guarded_write_without_lock():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def ok(self):
+                with self._lock:
+                    self._q.append(1)
+                    self._q = []
+
+            def bad(self):
+                self._q.append(1)
+    """)
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T001", 14, "W.bad:_q")]
+
+
+def test_t001_requires_annotation_seeds_and_checks_callers():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def _push(self, item):  # requires: _lock
+                self._q.append(item)
+
+            def good(self):
+                with self._lock:
+                    self._push(1)
+
+            def bad(self):
+                self._push(2)
+    """)
+    # _push's own body is clean (the annotation seeds its lockset); the
+    # lock-free call site is the violation
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T001", 16, "W.bad:_push")]
+
+
+def test_t002_rank_inversion_and_reentry():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ok(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def bad(self):
+                with self._b:
+                    with self._a:
+                        pass
+
+            def twice(self):
+                with self._a:
+                    with self._a:
+                        pass
+    """, lock_ranks={"_a": 0, "_b": 10})
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T002", 15, "W.bad:_b>_a"),
+        ("GRAFT-T002", 20, "W.twice:_a>_a")]
+
+
+def test_t002_cross_object_callee_rank():
+    # `sink.inc(...)` is name-ranked at 30 (the obs surface); calling it
+    # while holding an equal-ranked lock inverts the hierarchy
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._m = threading.Lock()
+
+            def bad(self, sink):
+                with self._m:
+                    sink.inc("x")
+
+            def ok(self, sink):
+                sink.inc("x")
+    """, lock_ranks={"_m": 30})
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T002", 9, "W.bad:_m>inc()")]
+
+
+def test_t003_resolution_under_lock():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, t):
+                with self._lock:
+                    t._fail(RuntimeError("x"))
+
+            def bad_cb(self, fn):
+                with self._lock:
+                    fn(self)
+
+            def ok(self, t):
+                t._fail(RuntimeError("x"))
+    """)
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T003", 9, "W.bad:_fail"),
+        ("GRAFT-T003", 13, "W.bad_cb:fn")]
+
+
+def test_t004_blocking_wait_under_foreign_lock():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self._ev = threading.Event()
+
+            def bad(self):
+                with self._lock:
+                    self._ev.wait()
+
+            def poll_ok(self, t):
+                with self._lock:
+                    t.exception(0)
+
+            def cond_ok(self):
+                with self._cond:
+                    self._cond.wait()
+    """)
+    # the literal-0 poll and the Condition self-wait (which atomically
+    # releases the condition) are the two legal forms
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T004", 11, "W.bad:wait")]
+
+
+def test_t005_unguarded_lazy_init():
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._reg = None  # guarded-by: _lock
+
+            def bad(self):
+                if self._reg is None:
+                    self._reg = {}
+                return self._reg
+
+            def ok(self):
+                if self._reg is None:
+                    with self._lock:
+                        if self._reg is None:
+                            self._reg = {}
+                return self._reg
+    """)
+    # the unguarded write is ALSO a T001 — check-then-set without the lock
+    # violates both; the double-checked `ok` form is clean for both
+    assert [(f.rule, f.line, f.subject) for f in fs] == [
+        ("GRAFT-T005", 9, "W.bad:_reg"),
+        ("GRAFT-T001", 10, "W.bad:_reg")]
+
+
+def test_thread_checks_nested_def_is_callback_context():
+    # a nested def runs LATER on an arbitrary thread: writes inside it are
+    # checked against an EMPTY lockset even when the def is created under
+    # the lock
+    fs = _tlint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def bad(self):
+                with self._lock:
+                    def later():
+                        self._q.append(1)
+                    return later
+    """)
+    assert [(f.rule, f.subject) for f in fs] == [
+        ("GRAFT-T001", "W.bad.later:_q")]
+
+
+def test_thread_checks_clean_host_layer():
+    """Every threaded host module passes the T-rules as committed — the
+    slice of the clean-tree gate this layer owns."""
+    assert thread_checks.lint_tree(cli.repo_root()) == []
+
+
+# ------------------------------------------------------- collective rules
+
+
+def _sp_mesh():
+    from jax.sharding import Mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("collective fixtures need >= 2 devices "
+                    "(conftest forces 8 host devices)")
+    return Mesh(np.array(jax.devices()[:2]), ("s",))
+
+
+def _smap(fn, mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from ddim_cold_tpu.parallel._compat import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=P("s"), out_specs=P("s"),
+                     check_vma=False)
+
+
+def test_c001_divergent_cond_inside_manual_region():
+    mesh = _sp_mesh()
+
+    def inner(x):
+        def refresh(v):
+            return jax.lax.psum(v, "s") + jax.lax.psum(v * 2.0, "s")
+
+        def reuse(v):
+            return jax.lax.psum(v, "s")
+
+        # the predicate is PER-SHARD (x differs per shard) — shards can
+        # take different branches and rendezvous out of order
+        return jax.lax.cond(x[0] > 0, refresh, reuse, x)
+
+    closed = jax.make_jaxpr(_smap(inner, mesh))(jnp.zeros((2,), jnp.float32))
+    fs = collective_checks.check_jaxpr(closed, "fix")
+    assert [(f.rule, f.subject) for f in fs] == [
+        ("GRAFT-C001", "fix:cond-divergent")]
+
+    def uniform(x):  # identical branch sequences — provably same rendezvous
+        return jax.lax.cond(x[0] > 0,
+                            lambda v: jax.lax.psum(v, "s"),
+                            lambda v: jax.lax.psum(v * 2.0, "s"), x)
+
+    closed = jax.make_jaxpr(_smap(uniform, mesh))(
+        jnp.zeros((2,), jnp.float32))
+    assert collective_checks.check_jaxpr(closed, "ok") == []
+
+
+def test_c001_divergent_cond_outside_manual_region_is_exempt():
+    """The drift-gate shape: a cond OUTSIDE shard_map whose branches carry
+    different collective counts is safe — its scalar predicate is
+    replicated, so every device takes the same branch together (the
+    in-tree refresh-vs-reuse cond over the sp attention)."""
+    mesh = _sp_mesh()
+
+    def sm(times):
+        def inner(v):
+            for _ in range(times):
+                v = jax.lax.psum(v, "s")
+            return v
+        return _smap(inner, mesh)
+
+    def outer(x):
+        return jax.lax.cond(jnp.sum(x) > 0, sm(2), sm(1), x)
+
+    closed = jax.make_jaxpr(outer)(jnp.zeros((2,), jnp.float32))
+    assert collective_checks.check_jaxpr(closed, "ok") == []
+
+
+def test_c001_collective_in_while_inside_manual_region():
+    mesh = _sp_mesh()
+
+    def inner(x):
+        return jax.lax.while_loop(
+            lambda v: jnp.sum(v) < 10.0,
+            lambda v: v + jax.lax.psum(v, "s"), x)
+
+    closed = jax.make_jaxpr(_smap(inner, mesh))(jnp.zeros((2,), jnp.float32))
+    fs = collective_checks.check_jaxpr(closed, "fix")
+    assert [(f.rule, f.subject) for f in fs] == [
+        ("GRAFT-C001", "fix:while:psum")]
+
+
+def test_c002_collective_outside_any_mesh():
+    closed = jax.make_jaxpr(lambda x: jax.lax.psum(x, "s"),
+                            axis_env=[("s", 2)])(
+        jnp.zeros((2,), jnp.float32))
+    fs = collective_checks.check_jaxpr(closed, "fix")
+    assert [(f.rule, f.subject) for f in fs] == [
+        ("GRAFT-C002", "fix:psum:s:no-mesh")]
+
+
+class _FakePrim:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeEqn:
+    def __init__(self, name, params):
+        self.primitive = _FakePrim(name)
+        self.params = params
+
+
+class _FakeJaxpr:
+    def __init__(self, eqns):
+        self.eqns = eqns
+
+
+class _FakeMesh:
+    axis_names = ("data",)
+
+
+def test_c002_axis_absent_from_mesh():
+    """jax itself refuses to trace a collective over an unbound axis name,
+    so the absent-axis branch is exercised on a duck-typed jaxpr (the
+    walker only reads .eqns/.primitive.name/.params — the same shapes a
+    version-skewed trace would present)."""
+    inner = _FakeJaxpr([_FakeEqn("ppermute", {"axis_name": "seq"})])
+    sm = _FakeEqn("shard_map", {"mesh": _FakeMesh(), "auto": frozenset(),
+                                "jaxpr": inner})
+    fs = collective_checks.check_jaxpr(_FakeJaxpr([sm]), "fix")
+    assert [(f.rule, f.subject) for f in fs] == [
+        ("GRAFT-C002", "fix:ppermute:seq")]
+
+
+def test_collective_signature_orders_per_axis():
+    mesh = _sp_mesh()
+
+    def inner(x):
+        g = jax.lax.all_gather(x, "s")
+        return jax.lax.psum(x, "s") + jnp.sum(g)
+
+    closed = jax.make_jaxpr(_smap(inner, mesh))(jnp.zeros((2,), jnp.float32))
+    sig = collective_checks.collective_signature(closed, "fix")
+    assert sig == {"s": ("all_gather", "psum")}
+    # a static-trip scan's body is walked once — the per-iteration order
+    # stands in for all iterations and stays deadlock-free by repetition
+
+    def scanned(x):
+        return jax.lax.scan(
+            lambda c, _: (jax.lax.psum(c, "s"), None), x, None, length=3)[0]
+
+    closed = jax.make_jaxpr(_smap(scanned, mesh))(
+        jnp.zeros((2,), jnp.float32))
+    assert collective_checks.check_jaxpr(closed, "ok") == []
+    assert collective_checks.collective_signature(closed, "ok") == {
+        "s": ("psum",)}
+
+
+def test_c001_passes_over_the_sp_serve_sweep():
+    """The acceptance gate for the pipeline-parallel precondition: every sp
+    sweep entry traces to a non-empty seq-axis collective signature (the
+    pass really sees the all_to_alls) and none violates C001/C002. Reuses
+    one cached sweep trace — the same path `graftcheck` runs."""
+    if jax.device_count() < 2:
+        pytest.skip("sp sweep entries need >= 2 devices")
+    traces: dict = {}
+    entries.serve_signatures(entries.Context(), traces=traces)
+    sp_subjects = [s for s in traces
+                   if traces[s][0].sp_mode != "none"]
+    assert sp_subjects  # the sweep must actually carry sp entries
+    for subject in sp_subjects:
+        _config, closed = traces[subject]
+        assert collective_checks.check_jaxpr(closed, subject) == []
+        sig = collective_checks.collective_signature(closed, subject)
+        assert "seq" in sig and sig["seq"], (subject, sig)
+
+
 # ------------------------------------------------------ baseline + CLI
 
 
@@ -349,11 +732,61 @@ def test_cli_fix_baseline_then_clean(tmp_path, monkeypatch):
     assert cli.main(["--only", "ast", "--baseline", base]) == 0
 
 
+def test_baseline_roundtrip_thread_and_collective_findings(tmp_path):
+    path = str(tmp_path / "base")
+    fs = [Finding("GRAFT-T001", "ddim_cold_tpu/serve/engine.py",
+                  "Engine.drain:_pending", 1033),
+          Finding("GRAFT-C001", "ddim_cold_tpu/serve/engine.py",
+                  "ddim_k500_ci2_sp2u:b4:cond-divergent", 0)]
+    assert write_baseline(path, fs) == 2
+    keys = load_baseline(path)
+    assert all(f.key in keys for f in fs)
+    assert {rule_layer(k.split(" ", 1)[0]) for k in keys} == {
+        "threads", "collective"}
+
+
+def test_cli_fix_baseline_only_refreshes_selected_layers(tmp_path,
+                                                         monkeypatch):
+    """--fix-baseline --only regenerates JUST the selected layers' rule
+    families, carrying the other layers' reviewed lines over verbatim —
+    adopting the T/C rules must not churn the A/J/S entries."""
+    base = str(tmp_path / "allow")
+    ast_f = Finding("GRAFT-A002", "x.py", "f:except Exception", 1)
+    t_old = Finding("GRAFT-T001", "y.py", "W.bad:_q", 5)
+    t_new = Finding("GRAFT-T003", "y.py", "W.bad:_fail", 9)
+
+    monkeypatch.setattr(cli, "collect", lambda *a, **k: [ast_f, t_old])
+    assert cli.main(["--fix-baseline", base]) == 0  # full: both layers
+    assert load_baseline(base) == {ast_f.key, t_old.key}
+
+    # the threads layer alone now reports a DIFFERENT finding: a partial
+    # refresh swaps the T entry and keeps the ast entry untouched
+    monkeypatch.setattr(cli, "collect", lambda *a, **k: [t_new])
+    assert cli.main(["--only", "T", "--fix-baseline", base]) == 0
+    assert load_baseline(base) == {ast_f.key, t_new.key}
+
+    # a FULL --fix-baseline stays authoritative for everything (no carry)
+    monkeypatch.setattr(cli, "collect", lambda *a, **k: [ast_f])
+    assert cli.main(["--fix-baseline", base]) == 0
+    assert load_baseline(base) == {ast_f.key}
+
+
+def test_cli_only_accepts_family_letters_and_names():
+    assert cli.parse_only(["T,C"]) == ("threads", "collective")
+    assert cli.parse_only(["ast", "j"]) == ("ast", "jaxpr")
+    assert cli.parse_only(["threads,threads"]) == ("threads",)
+    with pytest.raises(Exception):
+        cli.parse_only(["x"])
+
+
 def test_rule_table_covers_all_emitted_rules():
     assert set(RULES) == {
         "GRAFT-J001", "GRAFT-J002", "GRAFT-J003", "GRAFT-J004", "GRAFT-J005",
         "GRAFT-J006", "GRAFT-J007", "GRAFT-A001", "GRAFT-A002", "GRAFT-A003",
-        "GRAFT-A004", "GRAFT-A005", "GRAFT-S001", "GRAFT-S002"}
+        "GRAFT-A004", "GRAFT-A005", "GRAFT-S001", "GRAFT-S002",
+        "GRAFT-T001", "GRAFT-T002", "GRAFT-T003", "GRAFT-T004", "GRAFT-T005",
+        "GRAFT-C001", "GRAFT-C002"}
+    assert {rule_layer(r) for r in RULES} == set(cli.LAYERS)
 
 
 # ------------------------------------------------------------- clean tree
@@ -367,7 +800,9 @@ def test_clean_tree_ast_and_sharding():
 
 def test_clean_tree_full_collect():
     """The acceptance gate: zero non-baselined findings on the whole repo —
-    the same three layers CI's `graftcheck --baseline` run enforces."""
+    all five layers, the same set CI's `graftcheck --baseline` run
+    enforces (the collective layer rides the jaxpr layer's sweep traces
+    here exactly as it does in the CLI)."""
     fs = cli.collect(cli.repo_root())
     assert [f.render() for f in fs] == []
 
