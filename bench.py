@@ -66,7 +66,9 @@ def _reuse_round_record(reason, root=None):
     import glob
     import re
 
-    from ddim_cold_tpu.utils.record import is_tpu_record, last_json_record
+    from ddim_cold_tpu.utils.record import (
+        is_tpu_record, last_json_record, run_metadata,
+    )
 
     here = root or os.path.dirname(os.path.abspath(__file__))
     rounds = [int(m.group(1)) for f in glob.glob(os.path.join(here, "BENCH_r*.json"))
@@ -126,6 +128,16 @@ def _reuse_round_record(reason, root=None):
                 if "file" in prior:
                     label["file"] = prior["file"]
             rec.setdefault("submetrics", {})["captured_earlier"] = label
+            # the replay event gets its own provenance stamp: run_meta
+            # orders this point at REPLAY time (where it sits in the
+            # committed series); the original capture's stamp — when the
+            # record predates stamping, there is none — stays under
+            # captured_meta so nothing is laundered
+            meta = run_metadata(chip=rec.get("chip"))
+            meta["replayed"] = True
+            if rec.get("run_meta"):
+                label["captured_meta"] = rec["run_meta"]
+            rec["run_meta"] = meta
             return rec
     return None
 
@@ -241,6 +253,21 @@ def main(argv=None):
                          "profiler trace. RAISES if tracing records nothing, "
                          "a span tree is incomplete, or anything compiles "
                          "after warmup; composes with --smoke for CI")
+    ap.add_argument("--attrib", action="store_true",
+                    help="run the attribution leg (ddim_cold_tpu/obs/"
+                         "attrib.py): capture a profiler trace of a warmed "
+                         "serving drain, attribute ≥90%% of device-busy "
+                         "time to the planted named scopes, join with "
+                         "utils/flops.py flop/byte estimates → per-scope "
+                         "MFU + roofline class + ranked fusion candidates, "
+                         "then run the obs/trend.py gate over the committed "
+                         "BENCH_r* series. RAISES if coverage misses the "
+                         "floor, anything compiles after warmup, or the "
+                         "captured drain is not bitwise the uncaptured one "
+                         "(attribution must be off-switchable); on CPU the "
+                         "capture has no device lanes, so coverage is "
+                         "asserted over the checked-in synthetic fixture — "
+                         "loudly labeled; composes with --smoke for CI")
     ap.add_argument("--xla-blockwise", action="store_true",
                     help="also time the pure-XLA blockwise attention leg in "
                          "the north-star section (retired from the default "
@@ -308,6 +335,7 @@ def main(argv=None):
 
     from ddim_cold_tpu.ops.flash_attention import KERNEL_REV
     from ddim_cold_tpu.ops.quant import QUANT_REV
+    from ddim_cold_tpu.utils.record import run_metadata
     from ddim_cold_tpu.utils.watchdog import StallWatchdog
 
     # both revision stamps ride every record (quant_rev mirrors kernel_rev:
@@ -333,6 +361,10 @@ def main(argv=None):
         "ms_per_step": None,
         "mfu": None,
         "submetrics": sub,
+        # provenance stamp (git sha, device kind once known, jax versions,
+        # externally-supplied timestamp) — obs/trend.py orders the committed
+        # series off it instead of inferring from filenames
+        "run_meta": run_metadata(),
     }
     # Default: armed only when an accelerator platform is CONFIGURED — read
     # from jax.config, not a backend query: the watchdog must be running
@@ -402,6 +434,7 @@ def main(argv=None):
         chip = jax.devices()[0].device_kind
         peak = flops_util.peak_tflops(chip)
         record.update(chip=chip, peak_bf16_tflops=peak)
+        record["run_meta"]["device_kind"] = chip
         mark("backend up")
         if env_stall is None and jax.default_backend() == "cpu":
             # platform was auto-DETECTED as cpu (nothing configured, no env
@@ -1787,6 +1820,136 @@ def main(argv=None):
             # cost the record (retries=0 — a second multi-GB trace attempt
             # would double the chip time for a nice-to-have)
             section("northstar_profile", run_northstar_profile, retries=0)
+
+        def run_attrib():
+            # the attribution leg (obs/attrib.py): one warmed serving drain
+            # captured under the profiler, device-busy time attributed to
+            # the planted named scopes and joined with utils/flops.py →
+            # per-scope MFU, roofline class, fusion candidates. Contracts
+            # that hold EVERYWHERE: the captured drain compiles nothing
+            # after warmup and its images are bitwise the uncaptured
+            # drain's (attribution off = untouched numerics). The ≥90%
+            # coverage floor is asserted on the capture when it carries
+            # device lanes (real chip); a jax CPU trace records host
+            # threads only, so there the floor runs over the checked-in
+            # synthetic fixture — loudly labeled, the run_parallel rule
+            # ("on CPU the structural contracts ARE the leg").
+            import math
+
+            from ddim_cold_tpu import serve
+            from ddim_cold_tpu.obs import attrib, trend
+            from ddim_cold_tpu.utils import profiling
+
+            os.makedirs("results", exist_ok=True)
+            if args.smoke or args.skip_northstar:
+                a_model, a_params = model, state.params
+                geom = dict(img_size=(64, 64), patch_size=8, mlp_ratio=1.0,
+                            **{kk: MODEL_CONFIGS["vit_tiny"][kk]
+                               for kk in ("embed_dim", "depth", "num_heads")})
+                buckets, k_att, flash = (2, 4), 400, False
+            else:
+                # the shared 200px north-star state (ns_ctx): the attribution
+                # evidence must be OF the north-star path, and the param init
+                # is paid once across sections
+                a_model = ns_flash_model()
+                a_params = ns_params_for(a_model)
+                geom = dict(img_size=(200, 200), patch_size=4, mlp_ratio=1.0,
+                            **{kk: MODEL_CONFIGS["oxford_flower_200_p4"][kk]
+                               for kk in ("embed_dim", "depth", "num_heads")})
+                buckets, k_att, flash = (8, 16), 20, True
+            bmax = max(buckets)
+            cfg = serve.SamplerConfig(k=k_att)
+            engine = serve.Engine(a_model, a_params, buckets=buckets)
+            mark(f"attrib warmup buckets={buckets}", budget_s=2 * stall_s)
+            wu = serve.warmup(engine, [cfg])
+            sizes = [bmax, bmax // 2, bmax - bmax // 2]
+
+            def drain(seed0):
+                tickets = [engine.submit(seed=seed0 + i, n=nr, config=cfg)
+                           for i, nr in enumerate(sizes)]
+                report = engine.run()
+                return report, [np.asarray(t.result(timeout=600))
+                                for t in tickets]
+
+            mark("attrib uncaptured drain")
+            r_off, outs_off = drain(700)
+            trace_dir = "results/attrib_profile"
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            mark("attrib profiler capture", budget_s=2 * stall_s)
+            with profiling.trace(trace_dir, perfetto=True):
+                r_on, outs_on = drain(700)  # same seeds: bitwise oracle
+            for a, b in zip(outs_off, outs_on):
+                if not np.array_equal(a, b):
+                    raise RuntimeError(
+                        "profiler capture changed the sampled images — "
+                        "attribution must be bitwise-off when disabled")
+            compiles = r_off["compiles"] + r_on["compiles"]
+            if compiles:
+                raise RuntimeError(
+                    f"attrib leg compiled {compiles} program(s) after warmup")
+
+            n_img = sum(sizes)
+            calls = n_img * math.ceil(1999 / k_att)  # ViT.py ⌈1999/k⌉ steps
+            per_img = flops_util.vit_scope_costs(flash=flash, quant=False,
+                                                 **geom)
+            costs = {s: {"flops": c["flops"] * calls,
+                         "bytes": c["bytes"] * calls}
+                     for s, c in per_img.items()}
+            trace_source = trace_dir
+            try:
+                rep = attrib.attribute(attrib.load_trace(trace_dir),
+                                       device_kind=chip, scope_costs=costs)
+            except attrib.AttribError as e:
+                rep = attrib.demo_report()  # old jax: no trace-event dump
+                trace_source = f"synthetic fixture — {e}"
+            if not rep["device_lanes"]:
+                rep = attrib.demo_report()
+                trace_source = ("synthetic fixture — the capture at "
+                                f"{trace_dir} has no device lanes "
+                                "(cpu backend records host threads only)")
+            if rep["coverage"] is None or rep["coverage"] < attrib.COVERAGE_FLOOR:
+                raise RuntimeError(
+                    f"attribution coverage {rep['coverage']} below the "
+                    f"{attrib.COVERAGE_FLOOR:.0%} floor — device time is "
+                    "escaping the registered scopes")
+            tr = trend.gate(os.path.dirname(os.path.abspath(__file__)))
+            top = [
+                {"scope": name, "self_s": node["self_s"],
+                 "share_of_busy": node["share_of_busy"], "mfu": node["mfu"],
+                 "achieved_tflops": node["achieved_tflops"],
+                 "roofline": node["roofline"]}
+                for name, node in attrib.ranked_scopes(rep)[:5]]
+            sub["attrib"] = {
+                "trace_source": trace_source,
+                "device_lanes": rep["device_lanes"],
+                "coverage": rep["coverage"],
+                "device_busy_s": rep["device_busy_s"],
+                "idle_s": rep["idle_s"],
+                "busy_fraction": rep["busy_fraction"],
+                "ridge_flops_per_byte": rep["ridge_flops_per_byte"],
+                "top_scopes": top,
+                "fusion_candidates": rep["fusion_candidates"][:3],
+                "bitwise_off": True,
+                "compiles_after_warmup": compiles,
+                "warmup_new_compiles": wu["new_compiles"],
+                "buckets": list(buckets), "k": k_att,
+                "trend": {"exit_code": tr["exit_code"],
+                          "statuses": tr["statuses"],
+                          "bench_points": tr["bench_points"],
+                          "multichip_points": tr["multichip_points"]},
+            }
+            hot = top[0] if top else {}
+            log(f"attrib: coverage {100 * rep['coverage']:.1f}% of "
+                f"{rep['device_busy_s']:.4f}s device-busy "
+                f"({rep['device_lanes']} lane(s), source: {trace_source}); "
+                f"hottest {hot.get('scope')} share={hot.get('share_of_busy')}"
+                f" mfu={hot.get('mfu')} [{hot.get('roofline')}]; "
+                f"{len(rep['fusion_candidates'])} fusion candidates; trend "
+                f"gate exit {tr['exit_code']} {tr['statuses']}; compiles "
+                f"after warmup: {compiles}")
+
+        if args.attrib:
+            section("attrib", run_attrib)
 
         # ------------------------------------------------- e2e with the data path
         if not args.skip_e2e:

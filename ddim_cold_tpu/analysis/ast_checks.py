@@ -63,7 +63,13 @@ HOST_ONLY_MODULES = ("ddim_cold_tpu/serve/batching.py",
                      # attribute here is a hidden device sync per emit
                      "ddim_cold_tpu/obs/metrics.py",
                      "ddim_cold_tpu/obs/spans.py",
-                     "ddim_cold_tpu/obs/device.py")
+                     "ddim_cold_tpu/obs/device.py",
+                     # trace attribution + the trend gate parse artifacts
+                     # after the fact — often in CI or on a laptop that
+                     # never saw the device; importing jax there would drag
+                     # a backend init into every report render
+                     "ddim_cold_tpu/obs/attrib.py",
+                     "ddim_cold_tpu/obs/trend.py")
 
 #: obs.metrics emit methods (rule A005) → the registry kind they imply
 _METRIC_EMITS = ("inc", "gauge", "observe")

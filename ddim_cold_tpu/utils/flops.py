@@ -43,6 +43,22 @@ PEAK_INT8_TOPS = {
 }
 
 
+#: HBM bandwidth GB/s per chip (published specs, same prefix-match keys as
+#: the peak tables) — the roofline's other axis: a scope whose arithmetic
+#: intensity sits below peak/bandwidth is bandwidth-bound no matter how the
+#: kernel schedules its MXU passes.
+HBM_GB_S = {
+    "TPU v6": 1638.0,  # Trillium
+    "TPU v5p": 2765.0,
+    "TPU v5 lite": 819.0,  # v5e
+    "TPU v5": 2765.0,
+    "TPU v4 lite": 614.0,  # v4i
+    "TPU v4": 1228.0,
+    "TPU v3": 900.0,
+    "TPU v2": 700.0,
+}
+
+
 def _prefix_lookup(table: dict, device_kind: str) -> float | None:
     best = None
     for kind, peak in table.items():
@@ -75,6 +91,23 @@ def mixed_peak_tflops(device_kind: str, int8_fraction: float = 0.0) -> float | N
         return bf16
     int8 = peak_int8_tops(device_kind) or bf16
     return 1.0 / (f / int8 + (1.0 - f) / bf16)
+
+
+def hbm_gb_s(device_kind: str) -> float | None:
+    """HBM bandwidth GB/s for the chip; None when unknown (CPU etc.)."""
+    return _prefix_lookup(HBM_GB_S, device_kind)
+
+
+def ridge_flops_per_byte(device_kind: str,
+                         int8_fraction: float = 0.0) -> float | None:
+    """The roofline ridge point: arithmetic intensity (FLOPs/byte) at which
+    peak compute and peak HBM bandwidth take equal time. Scopes below it are
+    HBM-bound, above it compute-bound. None when either peak is unknown."""
+    peak = mixed_peak_tflops(device_kind, int8_fraction)
+    bw = hbm_gb_s(device_kind)
+    if peak is None or bw is None:
+        return None
+    return peak * 1e12 / (bw * 1e9)
 
 
 def vit_forward_flops(*, img_size=(64, 64), patch_size=8, embed_dim=384,
@@ -124,3 +157,48 @@ def mfu(flops_per_step: float, step_seconds: float, device_kind: str,
     if peak is None or step_seconds <= 0:
         return None
     return flops_per_step / (step_seconds * peak * 1e12 * n_devices)
+
+
+def vit_scope_costs(*, img_size=(64, 64), patch_size=8, embed_dim=384,
+                    depth=7, num_heads=12, mlp_ratio=1.0, in_chans=3,
+                    flash=False, quant=False) -> dict:
+    """FLOP + HBM-byte estimates for ONE image's forward pass, split by the
+    named scopes profiling.scope plants (obs/attrib.py joins these against
+    per-scope device time → achieved TFLOP/s, MFU, roofline class).
+
+    Each entry is the scope's INCLUSIVE cost — ``sampler/model`` carries the
+    whole forward, matching attribution's rollup time (an event inside
+    ``flash_attention/fwd`` counts toward both). Byte estimates are the
+    minimal HBM traffic: weights once per call, activations read+written at
+    layer boundaries, and — for the flash path — q/k/v/out streamed without
+    materializing the N² score matrix. Elementwise traffic rides along with
+    the GEMMs it fuses into, same convention as the FLOP side.
+    """
+    H, W = img_size
+    n = (H // patch_size) * (W // patch_size) + 1
+    d = embed_dim
+    act_b = 2  # bf16 activations
+    w_b = 1 if quant else 2  # int8 trunk weights under w8a16
+    attn_flops = 2.0 * depth * 2 * n * n * d
+    dense_flops = 2.0 * depth * (3 * n * d * d + n * d * d
+                                 + 2 * n * d * d * mlp_ratio)
+    patch_flops = 2.0 * 2 * n * (patch_size * patch_size * in_chans) * d
+    # bytes: flash attention streams q, k, v in and the context out once per
+    # layer; trunk denses read their weights plus in/out activations for the
+    # qkv, proj and two MLP GEMMs; patch/head move the pixel-space tensors
+    # and their (shared-shape) weight once each.
+    attn_bytes = float(depth * 4 * n * d * act_b)
+    dense_bytes = float(depth * ((4 + 2 * mlp_ratio) * d * d * w_b
+                                 + 8 * n * d * act_b))
+    patch_bytes = float(2 * n * (patch_size * patch_size * in_chans) * act_b
+                        + 2 * (patch_size * patch_size * in_chans) * d * 2)
+    costs = {"sampler/model": {
+        "flops": attn_flops + dense_flops + patch_flops,
+        "bytes": attn_bytes + dense_bytes + patch_bytes}}
+    if flash:
+        costs["flash_attention/fwd"] = {"flops": attn_flops,
+                                        "bytes": attn_bytes}
+    if quant:
+        costs["dequant_matmul/pallas"] = {"flops": dense_flops,
+                                          "bytes": dense_bytes}
+    return costs

@@ -18,10 +18,20 @@ import jax
 import numpy as np
 
 
-def trace(log_dir: str):
+def trace(log_dir: str, perfetto: bool = False):
     """Capture a device trace into ``log_dir`` — ``jax.profiler.trace`` is
     already a context manager with stop-in-finally semantics; pass through so
-    upstream improvements (perfetto links, etc.) come for free."""
+    upstream improvements (perfetto links, etc.) come for free.
+
+    ``perfetto=True`` additionally writes the trace-event JSON dump
+    (``plugins/profile/<run>/perfetto_trace.json.gz``) that
+    ``obs/attrib.py`` parses — without it the capture is xplane-only and
+    attribution has nothing to read. Guarded for older jax signatures."""
+    if perfetto:
+        try:
+            return jax.profiler.trace(log_dir, create_perfetto_trace=True)
+        except TypeError:  # jax predating create_perfetto_trace
+            pass
     return jax.profiler.trace(log_dir)
 
 
@@ -50,18 +60,19 @@ def scope(name: str):
     return jax.named_scope(name)
 
 
-def span_trace(log_dir: str, span=None):
+def span_trace(log_dir: str, span=None, perfetto: bool = False):
     """A ``jax.profiler`` trace session keyed to an obs span: the capture
     lands in ``log_dir/trace_<trace_id>_<span_id>`` (or ``log_dir`` when no
     span / tracing disabled), so a slow request's profiler timeline is
     findable from its span ids — the span→profiler workflow for the MFU
-    push (PERF.md "Observability")."""
+    push (PERF.md "Observability"). ``perfetto=True`` adds the trace-event
+    JSON dump ``obs/attrib.py`` attributes (see :func:`trace`)."""
     import os
 
     ctx = getattr(span, "ctx", None)
     if ctx is not None:
         log_dir = os.path.join(log_dir, f"trace_{ctx.trace_id}_{ctx.span_id}")
-    return jax.profiler.trace(log_dir)
+    return trace(log_dir, perfetto=perfetto)
 
 
 def enable_nan_checks(enable: bool = True) -> None:

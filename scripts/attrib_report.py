@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Render a profiler capture as a slowest-scope-first attribution table.
+
+Input: a ``jax.profiler`` output directory (``bench --attrib`` writes
+``results/attrib_profile``; ``profiling.span_trace(..., perfetto=True)``
+writes span-keyed ones), a ``.trace.json[.gz]`` file, or ``--demo`` for the
+checked-in synthetic fixture — the same rendering path either way, so the
+report format is testable without a chip (the ``obs_report --demo`` rule).
+
+Usage:
+  python scripts/attrib_report.py results/attrib_profile --device-kind "TPU v5 lite"
+  python scripts/attrib_report.py --demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddim_cold_tpu.obs import attrib  # noqa: E402
+
+
+def _fmt(v, spec="{}", none="-"):
+    return none if v is None else spec.format(v)
+
+
+def _render(report: dict) -> str:
+    lines = [
+        f"device: {report['device_kind'] or '?'} · "
+        f"{report['device_lanes']} lane(s) · peak "
+        f"{_fmt(report['peak_bf16_tflops'])} TFLOP/s · HBM "
+        f"{_fmt(report['hbm_gb_s'])} GB/s · ridge "
+        f"{_fmt(report['ridge_flops_per_byte'])} FLOP/byte",
+        f"window {report['window_s']:.6f}s · busy "
+        f"{report['device_busy_s']:.6f}s "
+        f"({_fmt(report['busy_fraction'], '{:.1%}')}) · idle gaps "
+        f"{report['idle_s']:.6f}s · coverage "
+        f"{_fmt(report['coverage'], '{:.1%}')} of busy attributed "
+        f"(floor {attrib.COVERAGE_FLOOR:.0%})",
+        "",
+        "| scope | self ms | total ms | share | TFLOP/s | MFU | bound |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name, node in attrib.ranked_scopes(report):
+        lines.append(
+            f"| {name} | {1000 * node['self_s']:.3f} | "
+            f"{1000 * node['total_s']:.3f} | "
+            f"{_fmt(node['share_of_busy'], '{:.1%}')} | "
+            f"{_fmt(node['achieved_tflops'])} | {_fmt(node['mfu'])} | "
+            f"{_fmt(node['roofline'])} |")
+    if report["tree"]:
+        lines += ["", "scope nesting: " + " · ".join(
+            f"{p} → {{{', '.join(kids)}}}"
+            for p, kids in sorted(report["tree"].items()))]
+    if report["fusion_candidates"]:
+        lines += ["", "fusion candidates (adjacent scoped ops, launch gap "
+                  f"≤ {attrib.DEFAULT_GAP_US:.0f}µs):"]
+        for c in report["fusion_candidates"][:5]:
+            lines.append(
+                f"  {c['pair'][0]} → {c['pair'][1]}: {c['count']}× · "
+                f"{c['total_gap_us']}µs reclaimable (mean "
+                f"{c['mean_gap_us']}µs) over {c['combined_busy_us']}µs busy")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="slowest-scope-first attribution table from a "
+                    "profiler trace")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="profiler output dir or .trace.json[.gz] file")
+    ap.add_argument("--demo", action="store_true",
+                    help="render the checked-in synthetic fixture (no "
+                         "trace/chip needed)")
+    ap.add_argument("--device-kind", default=None,
+                    help="chip name for the flops/roofline join (e.g. "
+                         "'TPU v5 lite'); omit for time-only attribution")
+    ap.add_argument("--gap-us", type=float, default=attrib.DEFAULT_GAP_US,
+                    help="fusion-candidate launch-gap ceiling")
+    ap.add_argument("--json", default=None,
+                    help="also write the full report to this path")
+    args = ap.parse_args(argv)
+    if args.demo:
+        report = attrib.demo_report(gap_us=args.gap_us)
+    elif args.trace:
+        try:
+            report = attrib.attribute(attrib.load_trace(args.trace),
+                                      device_kind=args.device_kind,
+                                      gap_us=args.gap_us)
+        except attrib.AttribError as e:
+            print(f"attrib_report: {e}", file=sys.stderr)
+            return 1
+        if not report["device_lanes"]:
+            print("attrib_report: trace has no device lanes (a jax CPU "
+                  "capture records host threads only) — nothing to "
+                  "attribute; try --demo for the fixture", file=sys.stderr)
+            return 1
+    else:
+        ap.error("pass a trace path or --demo")
+    print(_render(report))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,8 +36,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def collect_points(run_dir: str, max_points: int):
     """→ ordered [(label, epoch|None, ckpt_path|None)] trend points: the
     random-init anchor, evenly-thinned snapshot epochs (first and last always
-    kept), then the run's best checkpoint."""
-    import numpy as np
+    kept — obs.trend.thin, the one thinning rule for trend series), then the
+    run's best checkpoint."""
+    from ddim_cold_tpu.obs import trend
 
     points = [("random", -1, None)]  # anchor: params as-initialized
     snap_dir = os.path.join(run_dir, "snapshots")
@@ -48,9 +49,7 @@ def collect_points(run_dir: str, max_points: int):
             if m:
                 snaps.append((int(m.group(1)), os.path.join(snap_dir, name)))
         snaps.sort()
-        if len(snaps) > max_points:  # thin evenly, keep first + last
-            idx = np.linspace(0, len(snaps) - 1, max_points).round()
-            snaps = [snaps[int(i)] for i in sorted(set(idx.astype(int)))]
+        snaps = trend.thin(snaps, max_points)
         points += [(f"epoch_{ep}", ep, path) for ep, path in snaps]
     best = os.path.join(run_dir, "bestloss.ckpt")
     if os.path.isdir(best):
@@ -197,9 +196,17 @@ def main(argv=None):
         print(f"[fid-trend] {label}: {value:.2f}", file=sys.stderr)
 
     wd.done()
+    # the output speaks the regression gate's language: per-point deltas
+    # under obs.trend's one noise-band policy (FID: lower is better), plus
+    # the run_meta provenance stamp every bench artifact now carries
+    from ddim_cold_tpu.obs import trend
+    from ddim_cold_tpu.utils.record import run_metadata
+
     out = {
         "metric": "fid_trend_cold",
-        "points": results,
+        "points": trend.annotate_deltas(results, "fid",
+                                        lower_is_better=True),
+        "run_meta": run_metadata(chip=str(jax.devices()[0].device_kind)),
         "n_samples": args.n_samples,
         "n_real": n_real_seen,
         "extractor": (f"seeded random init (PRNGKey({args.inception_seed})) — "

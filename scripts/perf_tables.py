@@ -21,6 +21,10 @@ from ddim_cold_tpu.utils.record import last_json_record  # noqa: E402
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _fmt_pct(v):
+    return "-" if v is None else f"{100 * v:.1f}%"
+
+
 def render(path: str) -> str:
     rec = last_json_record(path)
     if rec is None:
@@ -40,6 +44,12 @@ def render(path: str) -> str:
         lines += [f"> REUSED record ({ce.get('file')}"
                   + (f", stale round {ce['stale_round']}" if "stale_round" in ce
                      else "") + ") — not a fresh measurement", ""]
+    rm = rec.get("run_meta")
+    if rm:
+        lines += [f"provenance: sha `{rm.get('git_sha')}` · jax "
+                  f"{rm.get('jax')} / jaxlib {rm.get('jaxlib')} · ts "
+                  f"{rm.get('timestamp')}"
+                  + (" · replayed" if rm.get("replayed") else ""), ""]
 
     rows = sub.get("batch_scaling")
     if rows:
@@ -227,6 +237,38 @@ def render(path: str) -> str:
             f"telemetry {tel.get('refreshes')}r/{tel.get('reuses')}c "
             f"(ratio {tel.get('refresh_ratio')}) · compiles after warmup "
             f"{ob.get('compiles_after_warmup')}")
+
+    at = sub.get("attrib")
+    if at:
+        top = at.get("top_scopes", [])
+        lines.append("")
+        lines.append(
+            f"**attribution:** {_fmt_pct(at.get('coverage'))} of device-busy "
+            f"attributed · busy {at.get('device_busy_s')}s / idle "
+            f"{at.get('idle_s')}s ({_fmt_pct(at.get('busy_fraction'))} busy) · "
+            f"{at.get('device_lanes')} lane(s) · ridge "
+            f"{at.get('ridge_flops_per_byte')} FLOP/byte · "
+            f"{len(at.get('fusion_candidates', []))} fusion candidates · "
+            f"compiles after warmup {at.get('compiles_after_warmup')} · "
+            f"source {at.get('trace_source')}")
+        if top:
+            lines += ["", "| scope | self ms | share | TFLOP/s | MFU | bound |",
+                      "|---|---|---|---|---|---|"]
+            for s in top:
+                lines.append(
+                    f"| {s.get('scope')} | {1000 * s.get('self_s', 0.0):.3f} | "
+                    f"{_fmt_pct(s.get('share_of_busy'))} | "
+                    f"{s.get('achieved_tflops')} | {s.get('mfu')} | "
+                    f"{s.get('roofline')} |")
+        tr = at.get("trend")
+        if tr:
+            st = tr.get("statuses", {})
+            lines.append(
+                f"trend gate: exit {tr.get('exit_code')} over "
+                f"{tr.get('bench_points')} bench + "
+                f"{tr.get('multichip_points')} multichip points · "
+                + (" · ".join(f"{k}={v}" for k, v in sorted(st.items()))
+                   or "no checks"))
 
     pl = sub.get("parallel")
     if pl and not pl.get("skipped"):
