@@ -32,18 +32,14 @@ import time
 
 BASELINE_IMG_PER_SEC = 702.0  # train.log steady state, 1×3090 (BASELINE.md)
 
-#: --flash-block-sweep configs for the 200px north-star kernel tuning; the
-#: CPU tile-rule guard (tests/test_flash_attention.py) imports this list so
-#: every entry is pre-checked against Mosaic's (8, 128) rule before it can
-#: burn a slot in the one hardware window
-FLASH_BLOCK_SWEEP = ((512, 512), (256, 1024), (256, 4096), (512, 4096))
-#: tuned (block_q, block_kv) for the N=2501 north-star flash leg: the r05
-#: on-chip sweep put full-sequence kv blocks ahead of streamed ones (512×4096:
-#: 7.48 img/s vs 5.78 at the 256×512 default, old f32-GEMM kernel). The
-#: kernel clamps block_kv to the padded sequence (2504 here) at runtime, so
-#: any ≥N entry is the same single-chunk config — this is the sweep's own
-#: (512, 4096) row promoted to the headline leg.
-NS_FLASH_BLOCKS = (512, 4096)
+# the north-star kernel block configs moved next to the kernel they tune
+# (ops/flash_attention.py) so the graftcheck kernels layer proves the exact
+# geometry this bench dispatches; re-exported here because the CPU tile-rule
+# guard (tests/test_flash_attention.py) and scripts/tpu_validate.py import
+# them from bench
+from ddim_cold_tpu.ops.flash_attention import (  # noqa: E402
+    FLASH_BLOCK_SWEEP, NS_FLASH_BLOCKS,
+)
 
 #: e2e's generated temp dataset, registered so a watchdog abort (os._exit
 #: skips every finally) can still remove it instead of leaking 4096 images
@@ -552,6 +548,28 @@ def main(argv=None):
                         f"{type(e).__name__}: {e}")
                     sub[name + "_error"] = f"{type(e).__name__}: {e}"
                     emit_snapshot()  # the error note survives a later kill
+
+        # ---------------------------------------------------- static memory budget
+        def run_memory_budget():
+            # abstract-trace-only (graftcheck's kernels+memory layers over the
+            # 200px registry): peak live HBM per sampler program and per-kernel
+            # VMEM land in the BENCH record so obs/trend.py bands residency
+            # regressions without costing a hardware window
+            from ddim_cold_tpu.analysis import memory_checks
+
+            mark("memory budget")
+            report = memory_checks.budget_report()
+            sub["memory"] = report
+            log(f"memory budget: peak {report['peak_hbm_gb']} GiB HBM, "
+                f"max kernel VMEM {report['max_kernel_vmem_mb']} MiB "
+                f"({report['device_kind']})")
+            if report["findings"]:
+                raise RuntimeError(
+                    f"{len(report['findings'])} static budget finding(s): "
+                    + "; ".join(report["findings"])[:500])
+
+        # deterministic static analysis — a finding won't heal on retry
+        section("memory_budget", run_memory_budget, retries=0)
 
         # --------------------------------------------------------- batch scaling
         scaling_rows = {}  # per-batch memo: a section retry redoes only the tail

@@ -15,6 +15,11 @@ code for the repo-specific hazards:
   the threaded host serving layer (``# guarded-by:`` annotation grammar).
 * :mod:`.collective_checks` — GRAFT-C001/C002 collective-order deadlock
   proofs over the serve sweep's cached traces (multi-axis mesh programs).
+* :mod:`.kernel_checks` — GRAFT-P001..P003 Mosaic tile legality, VMEM fit,
+  and padding waste for every ``pallas_call`` in the traces (including the
+  first-class 200px kernel entries at the north-star geometry).
+* :mod:`.memory_checks` — GRAFT-M001/M002 donation-aware peak-HBM liveness
+  bound and padded-residency check per traced program.
 * :mod:`.cli` — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``;
   nonzero exit on non-baselined findings; ``--fix-baseline`` regenerates
   the reviewed allowlist (``--only`` limits it to selected rule families).

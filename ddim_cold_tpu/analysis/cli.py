@@ -1,9 +1,10 @@
 """graftcheck CLI — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``.
 
-Runs the five layers (AST lint, thread-safety lockset analysis, jaxpr
-entry checks + serve-signature sweep, collective-order proofs over the
-sweep's traces, sharding coverage), subtracts the reviewed ``--baseline``
-allowlist, prints the rest and exits nonzero if any remain.
+Runs the seven layers (AST lint, thread-safety lockset analysis, jaxpr
+entry checks + serve-signature sweep, Pallas kernel-geometry verification,
+peak-HBM budget analysis, collective-order proofs over the sweep's traces,
+sharding coverage), subtracts the reviewed ``--baseline`` allowlist,
+prints the rest and exits nonzero if any remain.
 ``--fix-baseline`` regenerates the allowlist deterministically instead
 (sorted, deduped) so its diffs review cleanly; combined with ``--only`` it
 refreshes ONLY the selected layers' rule families, preserving the other
@@ -11,13 +12,16 @@ layers' reviewed lines verbatim.
 
 ``--only`` takes layer names or rule-family letters, comma-separable:
 ``--only T,C`` ≡ ``--only threads --only collective`` — the fast host-side
-path CI runs without paying for a trace sweep.
+path CI runs without paying for a trace sweep; ``--only P,M`` is the
+kernel-geometry + memory-budget pre-flight for block-shape work.
 
-The jaxpr/collective layers trace real model code, so the CLI pins jax to
-CPU before any trace (the check is backend-independent — it never executes
-a program) unless ``--platform`` says otherwise. The collective layer
-reuses the jaxpr layer's sweep traces when both run — the sweep is traced
-once either way.
+The trace-consuming layers (jaxpr, kernels, memory, collective) pin jax to
+CPU before any trace (the checks are backend-independent — they never
+execute a program) unless ``--platform`` says otherwise, and SHARE traces:
+the jaxpr layer's world-A sweep feeds collective/kernels/memory, its
+build/train entry traces feed kernels — each program is traced once no
+matter how many layers walk it. The 200px kernel entries
+(``entries.kernel_entries``) are traced once and shared by kernels+memory.
 """
 
 from __future__ import annotations
@@ -28,11 +32,13 @@ import sys
 
 from ddim_cold_tpu.analysis import findings as F
 
-LAYERS = ("ast", "jaxpr", "sharding", "threads", "collective")
+LAYERS = ("ast", "jaxpr", "kernels", "memory", "sharding", "threads",
+          "collective")
 
 #: rule-family letters accepted by --only as layer aliases (--only T,C)
 _ONLY_ALIASES = {"a": "ast", "j": "jaxpr", "s": "sharding",
-                 "t": "threads", "c": "collective"}
+                 "t": "threads", "c": "collective",
+                 "p": "kernels", "m": "memory"}
 
 
 def parse_only(values) -> tuple:
@@ -73,22 +79,50 @@ def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
         from ddim_cold_tpu.analysis import thread_checks
 
         out += thread_checks.lint_tree(root)
-    # the collective layer consumes the jaxpr layer's sweep traces when
-    # both run (one sweep trace either way); alone, it traces one world
-    traces = {} if "collective" in only else None
+    # the collective/kernels/memory layers consume the jaxpr layer's sweep
+    # traces when they run together (one sweep trace no matter how many
+    # layers walk it); the kernels layer additionally rides the jaxpr
+    # layer's build/train entry traces. Without the jaxpr layer, one world
+    # is traced here and shared the same way.
+    need_sweep = any(layer in only
+                     for layer in ("collective", "kernels", "memory"))
+    traces = {} if need_sweep else None
+    entry_traces = {} if "kernels" in only else None
     if "jaxpr" in only:
         from ddim_cold_tpu.analysis import entries
 
-        out += entries.run_entry_checks(max_const_bytes=max_const_bytes)
+        out += entries.run_entry_checks(max_const_bytes=max_const_bytes,
+                                        traces=entry_traces)
         out += entries.run_serve_signature_check(traces=traces)
     elif traces is not None:
         from ddim_cold_tpu.analysis import entries
 
-        entries.serve_signatures(entries.Context(), traces=traces)
-    if traces is not None:
+        ctx = entries.Context()
+        entries.serve_signatures(ctx, traces=traces)
+        if entry_traces is not None:
+            entry_traces.update((e.name, (e, e.trace()))
+                                for e in entries.build_entries(ctx))
+    if "collective" in only:
         from ddim_cold_tpu.analysis import collective_checks
 
         out += collective_checks.check_serve_collectives(traces)
+    # the 200px kernel entries are traced once, shared by kernels+memory
+    ktraces = None
+    if "kernels" in only or "memory" in only:
+        from ddim_cold_tpu.analysis import entries
+
+        ktraces = entries.kernel_traces()
+    if "kernels" in only:
+        from ddim_cold_tpu.analysis import kernel_checks
+
+        out += kernel_checks.run_kernel_checks(serve_traces=traces,
+                                               entry_traces=entry_traces,
+                                               kernel_traces=ktraces)
+    if "memory" in only:
+        from ddim_cold_tpu.analysis import memory_checks
+
+        out += memory_checks.run_memory_checks(serve_traces=traces,
+                                               kernel_traces=ktraces)
     if "sharding" in only:
         from ddim_cold_tpu.analysis import sharding_checks
 

@@ -149,6 +149,10 @@ class Entry:
     static_args: tuple = ()
     kwargs: dict = field(default_factory=dict)
     donates: bool = False
+    #: layer hints: ``tokens`` = the logical token count (the P003/M002
+    #: padding checks charge padded extents against it), ``rows`` = batch
+    #: rows, ``memory`` = run the M-rules' liveness walk over this entry
+    meta: dict = field(default_factory=dict)
 
     def _call(self, *dyn):
         return self.jitted(*self.static_args, *dyn, **self.kwargs)
@@ -309,12 +313,17 @@ def build_entries(ctx: Context) -> list[Entry]:
     return entries
 
 
-def run_entry_checks(max_const_bytes: int = 1 << 20) -> list[Finding]:
-    """J001–J005 over every registered entry."""
+def run_entry_checks(max_const_bytes: int = 1 << 20,
+                     traces: dict | None = None) -> list[Finding]:
+    """J001–J005 over every registered entry. When ``traces`` is passed
+    (a dict), each entry's ``(entry, closed_jaxpr)`` is stashed into it —
+    the kernels layer (P-rules) walks these instead of re-tracing."""
     ctx = Context()
     findings: list[Finding] = []
     for e in build_entries(ctx):
         closed = e.trace()
+        if traces is not None:
+            traces[e.name] = (e, closed)
         out_shapes = e.out_shapes()
         findings += jaxpr_checks.check_accumulation(closed, e.name, e.path)
         findings += jaxpr_checks.check_weak_types(out_shapes, e.name, e.path)
@@ -427,6 +436,112 @@ def serve_signatures(ctx: Context, findings: list | None = None,
             if traces is not None:
                 traces[subject] = (config, closed)
     return out
+
+
+# ---------------------------------------------------------------------------
+# 200px kernel/memory entries — the geometry that crashed r04
+# ---------------------------------------------------------------------------
+
+#: the north-star model the kernels/memory layers prove statically
+NS_MODEL = "oxford_flower_200_p4"
+NS_TOKENS = 2501   # (200/4)² patches + cls — the N Mosaic rejected on r04
+NS_ROWS = 16       # the bench's north-star batch
+NS_K = 20          # the north-star DDIM step count
+
+_FLASH_PATH = "ddim_cold_tpu/ops/flash_attention.py"
+_QUANT_PATH = "ddim_cold_tpu/ops/quant.py"
+
+
+def kernel_entries() -> list[Entry]:
+    """First-class 200px entries (N=2501; f32, bf16, w8a16): the full
+    sampler scans the bench's north-star legs dispatch — every in-tree
+    pallas_call at the EXACT geometry that crashed r04 — plus standalone
+    flash forward/grad traces per (dtype, block config) covering the
+    backward dq/dkv kernels and every ``--flash-block-sweep`` row, and the
+    dequant-pallas kernel at the 200px trunk GEMM shapes. The TINY serve
+    sweep contains zero pallas_calls (it serves quant="xla" only), so
+    these entries ARE the kernels layer's real coverage.
+
+    Tracing stays abstract end to end (eval_shape params); the whole
+    registry traces in a few seconds on CPU."""
+    from ddim_cold_tpu.models.vit import MODEL_CONFIGS, DiffusionViT
+    from ddim_cold_tpu.ops import quant, sampling
+    from ddim_cold_tpu.ops.flash_attention import (
+        FLASH_BLOCK_SWEEP, NS_FLASH_BLOCKS, flash_attention,
+    )
+
+    cfg = MODEL_CONFIGS[NS_MODEL]
+    key = jax.random.PRNGKey(0)
+    entries: list[Entry] = []
+
+    # full sampler programs, flash trunk at the tuned north-star blocks —
+    # these feed BOTH layers (P over their pallas_calls, M over the scan)
+    base = DiffusionViT(dtype=jnp.bfloat16, use_flash=True,
+                        flash_blocks=NS_FLASH_BLOCKS, **cfg)
+    H, W = base.img_size
+    x2 = jax.ShapeDtypeStruct((2, H, W, base.in_chans), jnp.float32)
+    t2 = jax.ShapeDtypeStruct((2,), jnp.int32)
+    xr = jax.ShapeDtypeStruct((NS_ROWS, H, W, base.in_chans), jnp.float32)
+    mem = dict(tokens=NS_TOKENS, rows=NS_ROWS, memory=True)
+    fparams = jax.eval_shape(base.init, key, x2, t2)["params"]
+    qparams = jax.eval_shape(quant.quantize_params, fparams)
+    for label, model in (("f32", base.clone(dtype=jnp.float32)),
+                         ("bf16", base),
+                         ("w8a16", base.clone(quant="pallas"))):
+        params = qparams if model.quant else fparams
+        entries.append(Entry(
+            f"ns200_{label}", _FLASH_PATH, sampling._ddim_scan_last,
+            (params, xr, key), (model,),
+            dict(k=NS_K, t_start=None, eta=0.0), donates=True,
+            meta=dict(mem)))
+
+    # standalone flash kernels per (dtype, blocks): forward for every
+    # sweep row, grad (the backward dq/dkv kernels) at the default and
+    # tuned configs. scale matches the model's head_dim=64.
+    qkv = jax.ShapeDtypeStruct((2, NS_TOKENS, cfg["num_heads"],
+                                cfg["embed_dim"] // cfg["num_heads"]),
+                               jnp.float32)
+    scale = (cfg["embed_dim"] // cfg["num_heads"]) ** -0.5
+    configs = []
+    for bq, bkv in ((256, 512), NS_FLASH_BLOCKS, *FLASH_BLOCK_SWEEP):
+        if (bq, bkv) not in configs:
+            configs.append((bq, bkv))
+    for dt_label, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        q = jax.ShapeDtypeStruct(qkv.shape, dtype)
+        for bq, bkv in configs:
+            def fwd(qq, kk, vv, _bq=bq, _bkv=bkv):
+                return flash_attention(qq, kk, vv, scale, _bq, _bkv)
+
+            entries.append(Entry(
+                f"flash200_{dt_label}_{bq}x{bkv}", _FLASH_PATH, fwd,
+                (q, q, q), meta=dict(tokens=NS_TOKENS)))
+            if (bq, bkv) in ((256, 512), NS_FLASH_BLOCKS):
+                def loss(qq, kk, vv, _f=fwd):
+                    return jnp.sum(_f(qq, kk, vv).astype(jnp.float32))
+
+                entries.append(Entry(
+                    f"flash200_grad_{dt_label}_{bq}x{bkv}", _FLASH_PATH,
+                    jax.grad(loss, argnums=(0, 1, 2)), (q, q, q),
+                    meta=dict(tokens=NS_TOKENS)))
+
+    # the dequant-pallas kernel at the 200px trunk GEMM shapes: qkv
+    # (E → 3E) and proj/mlp (E → E) over M = rows·N activation rows
+    E = cfg["embed_dim"]
+    M = NS_ROWS * NS_TOKENS
+    for label, n_out in (("qkv", 3 * E), ("proj", E)):
+        entries.append(Entry(
+            f"dequant200_{label}", _QUANT_PATH, quant._dequant_matmul_pallas,
+            (jax.ShapeDtypeStruct((M, E), jnp.bfloat16),
+             jax.ShapeDtypeStruct((E, n_out), jnp.int8),
+             jax.ShapeDtypeStruct((n_out,), jnp.float32))))
+    return entries
+
+
+def kernel_traces() -> dict:
+    """``name → (entry, closed_jaxpr)`` for the 200px registry — the
+    shared input of the kernels/memory layers and bench's static
+    memory-budget leg."""
+    return {e.name: (e, e.trace()) for e in kernel_entries()}
 
 
 def run_serve_signature_check(traces: dict | None = None) -> list[Finding]:

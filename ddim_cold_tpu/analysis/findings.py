@@ -80,13 +80,29 @@ RULES = {
     "GRAFT-C002": "collective over a mesh axis the program's mesh does not "
                   "define (or outside any mesh) — unlowerable or silently "
                   "wrong sp program",
+    "GRAFT-P001": "Pallas block geometry violates the Mosaic tile rules "
+                  "(min sublane×lane tile per dtype, whole-dim span, "
+                  "block-divides-array) or the grid is not fully static — "
+                  "the r04 on-chip rejection class, invisible to CPU "
+                  "interpret mode",
+    "GRAFT-P002": "Pallas kernel's per-program VMEM footprint (double-"
+                  "buffered in/out blocks + VMEM scratch) exceeds the "
+                  "device kind's VMEM capacity",
+    "GRAFT-P003": "Pallas grid/block padding inflates kernel compute "
+                  "beyond the waste threshold at a registered geometry",
+    "GRAFT-M001": "traced program's donation-aware peak live HBM bound "
+                  "exceeds the device kind's HBM budget",
+    "GRAFT-M002": "bucket/sequence padding inflates a traced program's "
+                  "resident token axis beyond the threshold over the "
+                  "logical payload",
 }
 
 #: rule-family letter (GRAFT-<X>NNN) → the CLI layer that emits it. The
 #: partial --fix-baseline (--only) uses this to know which baseline lines a
 #: layer run is authoritative for.
 RULE_LAYERS = {"A": "ast", "J": "jaxpr", "S": "sharding",
-               "T": "threads", "C": "collective"}
+               "T": "threads", "C": "collective",
+               "P": "kernels", "M": "memory"}
 
 
 def rule_layer(rule: str) -> str:

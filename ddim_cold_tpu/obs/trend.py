@@ -60,6 +60,11 @@ BENCH_CHECKS = (
     ("submetrics.sampler_throughput_200px_k20_flash.value", "higher"),
     ("submetrics.serving.img_per_sec", "higher"),
     ("submetrics.e2e_train_throughput_warm.value", "higher"),
+    # static memory-budget rollups (bench's memory_budget section, computed
+    # by analysis/memory_checks.budget_report) — residency creep is a
+    # regression even when throughput holds
+    ("submetrics.memory.peak_hbm_gb", "lower"),
+    ("submetrics.memory.max_kernel_vmem_mb", "lower"),
 )
 MULTICHIP_CHECKS = (
     ("rc", "zero"),

@@ -54,6 +54,21 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 #: one every pre-r05b hardware record measured — had no stamp.
 KERNEL_REV = "bf16-gemm-v2"
 
+#: tuned (block_q, block_kv) for the N=2501 north-star flash leg: the r05
+#: on-chip sweep put full-sequence kv blocks ahead of streamed ones (512×4096:
+#: 7.48 img/s vs 5.78 at the 256×512 default, old f32-GEMM kernel). The
+#: kernel clamps block_kv to the padded sequence (2504 here) at runtime, so
+#: any ≥N entry is the same single-chunk config. Lives here (not bench.py)
+#: so the graftcheck kernels layer and the CPU tile-rule guard verify the
+#: EXACT geometry the bench dispatches — bench re-exports both names.
+NS_FLASH_BLOCKS = (512, 4096)
+
+#: bench --flash-block-sweep configs for the 200px north-star kernel tuning;
+#: tests/test_flash_attention.py and the graftcheck kernels layer pre-check
+#: every entry against Mosaic's tile rules before it can burn a slot in the
+#: one hardware window
+FLASH_BLOCK_SWEEP = ((512, 512), (256, 1024), (256, 4096), (512, 4096))
+
 
 # ---------------------------------------------------------------------------
 # forward

@@ -63,6 +63,11 @@ def legal_block(requested: int, dim: int, dtype, *, lane: bool = False,
     if dim < 1:
         raise ValueError(f"array dim must be >= 1, got {dim}")
     unit = LANE if lane else sublane_unit(dtype)
-    unit = unit * min_unit // np.gcd(unit, min_unit)  # lcm
+    # int(): np.gcd promotes the lcm to np.int64, which would propagate into
+    # every grid entry computed from the block — Pallas treats a non-Python-
+    # int grid dim as DYNAMIC (DynamicGridDim), silently forfeiting the
+    # static-grid scheduling the kernels are written for (graftcheck P001
+    # proves all in-tree grids fully static)
+    unit = int(unit * min_unit // np.gcd(unit, min_unit))  # lcm
     full = round_up(dim, unit)
     return min(round_up(requested, unit), full)

@@ -59,6 +59,35 @@ HBM_GB_S = {
 }
 
 
+#: per-core VMEM capacity in bytes (published specs / pallas guide; same
+#: prefix-match keys). This is the budget every Pallas kernel's per-program
+#: footprint — in/out blocks double-buffered by the pipeline, plus VMEM
+#: scratch — must fit inside (graftcheck P002, analysis/kernel_checks.py).
+VMEM_BYTES = {
+    "TPU v6": 32 << 20,  # Trillium: 32 MiB
+    "TPU v5p": 16 << 20,
+    "TPU v5 lite": 16 << 20,  # v5e — the bench chip
+    "TPU v5": 16 << 20,
+    "TPU v4": 16 << 20,
+    "TPU v3": 16 << 20,
+    "TPU v2": 16 << 20,
+}
+
+#: per-chip HBM capacity in bytes (published specs) — the budget a served
+#: program's statically estimated peak live bytes must fit inside
+#: (graftcheck M001, analysis/memory_checks.py).
+HBM_BYTES = {
+    "TPU v6": 32 << 30,  # Trillium
+    "TPU v5p": 95 << 30,
+    "TPU v5 lite": 16 << 30,  # v5e — the bench chip
+    "TPU v5": 95 << 30,
+    "TPU v4 lite": 8 << 30,  # v4i
+    "TPU v4": 32 << 30,
+    "TPU v3": 32 << 30,
+    "TPU v2": 16 << 30,
+}
+
+
 def _prefix_lookup(table: dict, device_kind: str) -> float | None:
     best = None
     for kind, peak in table.items():
@@ -91,6 +120,18 @@ def mixed_peak_tflops(device_kind: str, int8_fraction: float = 0.0) -> float | N
         return bf16
     int8 = peak_int8_tops(device_kind) or bf16
     return 1.0 / (f / int8 + (1.0 - f) / bf16)
+
+
+def vmem_bytes(device_kind: str) -> int | None:
+    """Per-core VMEM capacity in bytes; None when unknown (CPU etc.)."""
+    v = _prefix_lookup(VMEM_BYTES, device_kind)
+    return None if v is None else int(v)
+
+
+def hbm_bytes(device_kind: str) -> int | None:
+    """Per-chip HBM capacity in bytes; None when unknown (CPU etc.)."""
+    v = _prefix_lookup(HBM_BYTES, device_kind)
+    return None if v is None else int(v)
 
 
 def hbm_gb_s(device_kind: str) -> float | None:

@@ -151,6 +151,16 @@ def rng():
 
 
 @pytest.fixture(scope="session")
+def kernel_traces():
+    """The 200px kernel-entry traces (graftcheck's kernels/memory layers),
+    built once per session — test_kernel_checks and test_memory_checks
+    both walk them, and the abstract trace is the expensive part."""
+    from ddim_cold_tpu.analysis import entries
+
+    return entries.kernel_traces()
+
+
+@pytest.fixture(scope="session")
 def synthetic_image_dir(tmp_path_factory):
     """A 10-image jpg folder (the integration-test dataset, SURVEY.md §4)."""
     from PIL import Image

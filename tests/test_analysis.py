@@ -773,6 +773,7 @@ def test_cli_fix_baseline_only_refreshes_selected_layers(tmp_path,
 
 def test_cli_only_accepts_family_letters_and_names():
     assert cli.parse_only(["T,C"]) == ("threads", "collective")
+    assert cli.parse_only(["P,M"]) == ("kernels", "memory")
     assert cli.parse_only(["ast", "j"]) == ("ast", "jaxpr")
     assert cli.parse_only(["threads,threads"]) == ("threads",)
     with pytest.raises(Exception):
@@ -785,7 +786,9 @@ def test_rule_table_covers_all_emitted_rules():
         "GRAFT-J006", "GRAFT-J007", "GRAFT-A001", "GRAFT-A002", "GRAFT-A003",
         "GRAFT-A004", "GRAFT-A005", "GRAFT-S001", "GRAFT-S002",
         "GRAFT-T001", "GRAFT-T002", "GRAFT-T003", "GRAFT-T004", "GRAFT-T005",
-        "GRAFT-C001", "GRAFT-C002"}
+        "GRAFT-C001", "GRAFT-C002",
+        "GRAFT-P001", "GRAFT-P002", "GRAFT-P003",
+        "GRAFT-M001", "GRAFT-M002"}
     assert {rule_layer(r) for r in RULES} == set(cli.LAYERS)
 
 
@@ -800,7 +803,7 @@ def test_clean_tree_ast_and_sharding():
 
 def test_clean_tree_full_collect():
     """The acceptance gate: zero non-baselined findings on the whole repo —
-    all five layers, the same set CI's `graftcheck --baseline` run
+    all seven layers, the same set CI's `graftcheck --baseline` run
     enforces (the collective layer rides the jaxpr layer's sweep traces
     here exactly as it does in the CLI)."""
     fs = cli.collect(cli.repo_root())
