@@ -264,6 +264,19 @@ def main(argv=None):
                          "capture has no device lanes, so coverage is "
                          "asserted over the checked-in synthetic fixture — "
                          "loudly labeled; composes with --smoke for CI")
+    ap.add_argument("--fusion", action="store_true",
+                    help="run the fused-trunk leg: ONE engine drains the "
+                         "same seeds through the unfused w8a16 sampler "
+                         "(quant='pallas') and the fused megakernel one "
+                         "(fused=True — dequant-qkv + flash + proj in one "
+                         "Pallas program, fused bias/GELU Mlp), then "
+                         "compares per-step latency and MFU. RAISES if "
+                         "either drain compiles after warmup or if the "
+                         "fused images diverge (bitwise at f32, allclose "
+                         "at bf16); on CPU the kernels run in interpret "
+                         "mode so timing is structural and MFU is None — "
+                         "the parity/compile contracts ARE the leg; "
+                         "composes with --smoke for CI")
     ap.add_argument("--xla-blockwise", action="store_true",
                     help="also time the pure-XLA blockwise attention leg in "
                          "the north-star section (retired from the default "
@@ -1968,6 +1981,115 @@ def main(argv=None):
 
         if args.attrib:
             section("attrib", run_attrib)
+
+        def run_fusion():
+            # the fused-trunk leg (PERF.md "Fused kernels"): one engine,
+            # one param tree, two compiled programs — the unfused w8a16
+            # sampler (quant="pallas": dequant matmuls + flash attention +
+            # XLA Mlp) and the fused one (fused=True: qkv-dequant/flash/
+            # proj megakernel + fused bias-GELU Mlp). Contracts that hold
+            # EVERYWHERE: both drains compile nothing after warmup and the
+            # fused images match the unfused ones — bitwise at f32 (the
+            # fused kernels relocate the dequant/bias epilogues without
+            # moving a single ulp; the fma contraction points and kv-chunk
+            # boundaries are pinned identical), allclose at bf16 (the MXU
+            # accumulates the two compositions in different block orders).
+            # Speedup/per-step/MFU are the chip numbers; on CPU the Pallas
+            # kernels run in interpret mode, so timing is structural only
+            # and MFU is None (no peak table) — the run_parallel rule: on
+            # CPU the structural contracts ARE the leg.
+            import math
+            import time as time_mod
+
+            from ddim_cold_tpu import serve
+
+            if args.smoke or args.skip_northstar:
+                # f32 activations: the CPU smoke asserts the BITWISE half
+                # of the oracle, not just allclose (the train model is bf16)
+                f_model = model.clone(dtype=jnp.float32, use_flash=True,
+                                      flash_blocks=NS_FLASH_BLOCKS)
+                f_params = state.params
+                geom = dict(img_size=(64, 64), patch_size=8, mlp_ratio=1.0,
+                            **{kk: MODEL_CONFIGS["vit_tiny"][kk]
+                               for kk in ("embed_dim", "depth", "num_heads")})
+                buckets, k_f = (2, 4), 400
+            else:
+                f_model = ns_flash_model()
+                f_params = ns_params_for(f_model)
+                geom = dict(img_size=(200, 200), patch_size=4, mlp_ratio=1.0,
+                            **{kk: MODEL_CONFIGS["oxford_flower_200_p4"][kk]
+                               for kk in ("embed_dim", "depth", "num_heads")})
+                buckets, k_f = (8, 16), 20
+            bmax = max(buckets)
+            # both configs share f_model.flash_blocks (the explicit blocks
+            # pin the same kv-chunk boundaries into both programs — that
+            # identity is what makes the f32 oracle bitwise, not allclose)
+            cfgs = {"unfused": serve.SamplerConfig(k=k_f, quant="pallas"),
+                    "fused": serve.SamplerConfig(k=k_f, quant="pallas",
+                                                 fused=True)}
+            engine = serve.Engine(f_model, f_params, buckets=buckets)
+            mark(f"fusion warmup buckets={buckets}", budget_s=2 * stall_s)
+            wu = serve.warmup(engine, list(cfgs.values()))
+            sizes = [bmax, bmax // 2]  # exercise two buckets per program
+            steps = math.ceil(1999 / k_f)  # DDIM scan length per request
+            per_img_flops = flops_util.vit_scope_costs(
+                **geom)["sampler/model"]["flops"]
+
+            legs, outs = {}, {}
+            for name, cfg in cfgs.items():
+                mark(f"fusion drain {name}")
+                t0 = time_mod.perf_counter()
+                tickets = [engine.submit(seed=900 + i, n=nr, config=cfg)
+                           for i, nr in enumerate(sizes)]
+                report = engine.run()
+                outs[name] = [np.asarray(t.result(timeout=600))
+                              for t in tickets]
+                dt = time_mod.perf_counter() - t0
+                if report["compiles"]:
+                    raise RuntimeError(
+                        f"fusion {name} drain compiled {report['compiles']} "
+                        "program(s) after warmup")
+                n_img = sum(sizes)
+                legs[name] = {
+                    "seconds": round(dt, 4),
+                    "img_per_sec": round(n_img / dt, 3),
+                    "per_step_ms": round(1e3 * dt / (len(sizes) * steps), 3),
+                    "mfu": flops_util.mfu(n_img * steps * per_img_flops,
+                                          dt, chip)}
+            exact = f_model.dtype == jnp.float32
+            maxd = max(float(np.max(np.abs(
+                a.astype(np.float32) - b.astype(np.float32))))
+                for a, b in zip(outs["unfused"], outs["fused"]))
+            if exact:
+                ok = all(np.array_equal(a, b) for a, b in
+                         zip(outs["unfused"], outs["fused"]))
+                if not ok:
+                    raise RuntimeError(
+                        "fused sampler diverged from unfused at f32 — the "
+                        f"fused kernels must be bitwise (max |Δ| {maxd})")
+            elif maxd > 0.1:
+                raise RuntimeError(
+                    f"fused sampler pixel delta {maxd} exceeds the bf16 "
+                    "allclose bound 0.1 vs the unfused program")
+            sub["fusion"] = {
+                "unfused": legs["unfused"], "fused": legs["fused"],
+                "speedup": round(legs["unfused"]["seconds"]
+                                 / legs["fused"]["seconds"], 3),
+                "oracle": "bitwise" if exact else "allclose",
+                "max_abs_pixel_delta": maxd,
+                "compiles_after_warmup": 0,
+                "warmup_new_compiles": wu["new_compiles"],
+                "buckets": list(buckets), "k": k_f, "steps": steps,
+            }
+            log(f"fusion: {legs['unfused']['seconds']}s unfused → "
+                f"{legs['fused']['seconds']}s fused "
+                f"({sub['fusion']['speedup']}×), per-step "
+                f"{legs['fused']['per_step_ms']}ms, mfu "
+                f"{legs['fused']['mfu']}, oracle {sub['fusion']['oracle']} "
+                f"(max |Δ| {maxd}), compiles after warmup 0")
+
+        if args.fusion:
+            section("fusion", run_fusion)
 
         # ------------------------------------------------- e2e with the data path
         if not args.skip_e2e:

@@ -475,7 +475,10 @@ def kernel_entries() -> list[Entry]:
     entries: list[Entry] = []
 
     # full sampler programs, flash trunk at the tuned north-star blocks —
-    # these feed BOTH layers (P over their pallas_calls, M over the scan)
+    # these feed BOTH layers (P over their pallas_calls, M over the scan).
+    # The fused variants dispatch the trunk megakernels (fused attention +
+    # fused Mlp, ops/flash_attention.py + ops/quant.py) so P001–P003/
+    # M001–M002 certify the exact programs bench --fusion runs.
     base = DiffusionViT(dtype=jnp.bfloat16, use_flash=True,
                         flash_blocks=NS_FLASH_BLOCKS, **cfg)
     H, W = base.img_size
@@ -487,7 +490,11 @@ def kernel_entries() -> list[Entry]:
     qparams = jax.eval_shape(quant.quantize_params, fparams)
     for label, model in (("f32", base.clone(dtype=jnp.float32)),
                          ("bf16", base),
-                         ("w8a16", base.clone(quant="pallas"))):
+                         ("w8a16", base.clone(quant="pallas")),
+                         ("w8a16_fused", base.clone(quant="pallas",
+                                                    fused=True)),
+                         ("w8a8_fused", base.clone(quant="w8a8",
+                                                   fused=True))):
         params = qparams if model.quant else fparams
         entries.append(Entry(
             f"ns200_{label}", _FLASH_PATH, sampling._ddim_scan_last,
@@ -534,6 +541,60 @@ def kernel_entries() -> list[Entry]:
             (jax.ShapeDtypeStruct((M, E), jnp.bfloat16),
              jax.ShapeDtypeStruct((E, n_out), jnp.int8),
              jax.ShapeDtypeStruct((n_out,), jnp.float32))))
+
+    # standalone fused trunk kernels at the 200px geometry, blocks from the
+    # committed autotune table (ops/tuning.py) — every (kernel, dtype, mode)
+    # variant the fused sampler can dispatch gets its own P-rule subject
+    from ddim_cold_tpu.ops import tuning
+    from ddim_cold_tpu.ops.flash_attention import fused_trunk_attention
+
+    heads = cfg["num_heads"]
+    wq = jax.ShapeDtypeStruct((E, 3 * E), jnp.int8)
+    sq = jax.ShapeDtypeStruct((3 * E,), jnp.float32)
+    bq_ = jax.ShapeDtypeStruct((3 * E,), jnp.float32)
+    wp = jax.ShapeDtypeStruct((E, E), jnp.int8)
+    sp_ = jax.ShapeDtypeStruct((E,), jnp.float32)
+    bp_ = jax.ShapeDtypeStruct((E,), jnp.float32)
+    for dt_label, dtype, mode in (("f32", jnp.float32, "pallas"),
+                                  ("bf16", jnp.bfloat16, "pallas"),
+                                  ("w8a8", jnp.float32, "w8a8")):
+        kernel_dt = jnp.int8 if mode == "w8a8" else dtype
+        fbq, fbkv = tuning.attn_blocks(NS_TOKENS, E, heads, kernel_dt,
+                                       device_kind=tuning.DEVICE_KIND)
+
+        def fattn(xx, a, b, c, d, e, f, _bq=fbq, _bkv=fbkv, _mode=mode):
+            return fused_trunk_attention(
+                xx, a, b, c, d, e, f, num_heads=heads, scale=scale,
+                block_q=_bq, block_kv=_bkv, mode=_mode)
+
+        entries.append(Entry(
+            f"fused200_attn_{dt_label}", _FLASH_PATH, fattn,
+            (jax.ShapeDtypeStruct((2, NS_TOKENS, E), dtype),
+             wq, sq, bq_, wp, sp_, bp_), meta=dict(tokens=NS_TOKENS)))
+
+    # fused Mlp at the 200px trunk shapes (mlp_ratio=1.0 → hidden = E):
+    # float, w8a16 and w8a8 variants over the full M = rows·N row count
+    b1 = jax.ShapeDtypeStruct((E,), jnp.float32)
+    b2 = jax.ShapeDtypeStruct((E,), jnp.float32)
+    for dt_label, x_dt, w_dt, mode in (
+            ("float_bf16", jnp.bfloat16, jnp.bfloat16, None),
+            ("w8a16_bf16", jnp.bfloat16, jnp.int8, "pallas"),
+            ("w8a8", jnp.float32, jnp.int8, "w8a8")):
+        kernel_dt = jnp.int8 if mode == "w8a8" else x_dt
+        bm = tuning.mlp_block_m(E, E, kernel_dt, quant=mode is not None,
+                                device_kind=tuning.DEVICE_KIND)
+        def fmlp(xx, w1_, b1_, w2_, b2_, *scales, _bm=bm, _mode=mode):
+            kw = (dict(scale1=scales[0], scale2=scales[1]) if scales
+                  else {})
+            return quant.mlp_pallas(xx, w1_, b1_, w2_, b2_, mode=_mode,
+                                    block_m=_bm, **kw)
+
+        entries.append(Entry(
+            f"mlp200_{dt_label}", _QUANT_PATH, fmlp,
+            (jax.ShapeDtypeStruct((M, E), x_dt),
+             jax.ShapeDtypeStruct((E, E), w_dt), b1,
+             jax.ShapeDtypeStruct((E, E), w_dt), b2,
+             *( (sp_, sp_) if mode else () ))))
     return entries
 
 

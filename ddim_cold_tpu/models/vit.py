@@ -76,6 +76,25 @@ def positionalencoding1d(d_model: int, length: int) -> np.ndarray:
     return pe
 
 
+class _DenseParams(nn.Module):
+    """Declares an ``nn.Dense``'s ``{kernel, bias}`` leaves — same names,
+    shapes, dtypes and initializers as Mlp's denses, at the same module path
+    when given the same ``name`` — WITHOUT computing the matmul. The float
+    fused-Mlp path consumes the raw leaves (ops/quant.mlp_pallas), and the
+    identical param structure keeps a fused and an unfused model
+    interchangeable on one param tree."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param("kernel", trunc_normal(std=0.02),
+                            (in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        return kernel, bias
+
+
 class Mlp(nn.Module):
     """2-layer GELU MLP with dropout after both linears (reference ViT.py:74-90)."""
 
@@ -83,10 +102,44 @@ class Mlp(nn.Module):
     out_features: int
     drop: float = 0.0
     dtype: Dtype = jnp.float32
-    quant: Optional[str] = None  # None | "xla" | "pallas" (ops/quant.py w8a16)
+    quant: Optional[str] = None  # None | "xla" | "pallas" | "w8a8" (ops/quant.py)
+    fused: bool = False  # whole fc1 → GELU → fc2 chain as ONE Pallas kernel
+    # (ops/quant.mlp_pallas) — the (M, hidden) activation never exists in HBM
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        # fused trunk Mlp: inference path — the inter-linear dropouts must be
+        # inactive (training with drop > 0 falls through to the unfused
+        # composition, which applies them), and quant="xla" explicitly opts
+        # out of Pallas kernels. The param holders declare the exact leaves
+        # the unfused denses would, so both paths share one tree.
+        if (self.fused and self.quant != "xla"
+                and (deterministic or self.drop == 0.0)):
+            from ddim_cold_tpu.ops import tuning
+            from ddim_cold_tpu.ops.quant import QuantParams, mlp_pallas
+
+            x = x.astype(self.dtype)
+            in_features = x.shape[-1]
+            if self.quant:
+                w1, s1, b1 = QuantParams(
+                    self.hidden_features, name="fc1")(in_features)
+                w2, s2, b2 = QuantParams(
+                    self.out_features, name="fc2")(self.hidden_features)
+                mode = self.quant  # "pallas" (w8a16) | "w8a8"
+                act_dt = jnp.int8 if self.quant == "w8a8" else x.dtype
+            else:
+                w1, b1 = _DenseParams(
+                    self.hidden_features, name="fc1")(in_features)
+                w2, b2 = _DenseParams(
+                    self.out_features, name="fc2")(self.hidden_features)
+                s1 = s2 = None
+                mode = None
+                act_dt = x.dtype
+            bm = tuning.mlp_block_m(in_features, self.hidden_features, act_dt,
+                                    quant=self.quant is not None)
+            return mlp_pallas(x, w1, b1, w2, b2, scale1=s1, scale2=s2,
+                              mode=mode, block_m=bm)
+
         if self.quant:
             from ddim_cold_tpu.ops.quant import QuantDense
 
@@ -161,6 +214,9 @@ class Attention(nn.Module):
     seq_valid_len: Optional[int] = None
     seq_varying_axes: Optional[tuple] = None
     quant: Optional[str] = None  # w8a16 qkv/proj kernels (ops/quant.py)
+    fused: bool = False  # qkv dequant-GEMM → flash → proj dequant-GEMM as
+    # ONE Pallas kernel (ops/flash_attention.fused_trunk_attention); needs
+    # quant in ("pallas", "w8a8") — the dequant producer IS the fusion
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -168,6 +224,45 @@ class Attention(nn.Module):
         B, N, C = x.shape
         head_dim = C // self.num_heads
         scale = self.qk_scale or head_dim**-0.5
+
+        # Flash/ring/fused paths never materialize the O(N²) weights, so they
+        # require inactive attention-dropout (else fall back to einsum) and
+        # no weight probing.
+        weightless_ok = not need_weights and (deterministic or self.attn_drop == 0.0)
+        seq_parallel = self.seq_mesh is not None and self.seq_axis is not None
+
+        # fused sampler trunk: the qkv dequant-matmul runs INSIDE the flash
+        # kernel as producer and the proj dequant-matmul consumes the
+        # attention output in place — the (B, N, 3C) qkv and (B, N, C)
+        # context activations never round-trip through HBM. Inference only
+        # (no VJP); the probe path (need_weights=True) and sp fall through
+        # to the unfused composition below, whose QuantDense declares the
+        # identical param leaves — one tree serves both.
+        if (self.fused and self.quant in ("pallas", "w8a8")
+                and not seq_parallel and not self.seq_manual
+                and weightless_ok):
+            from ddim_cold_tpu.ops import tuning
+            from ddim_cold_tpu.ops.flash_attention import fused_trunk_attention
+            from ddim_cold_tpu.ops.quant import QuantParams
+
+            w_qkv, s_qkv, b_qkv = QuantParams(
+                3 * self.dim, use_bias=self.qkv_bias, name="qkv")(C)
+            w_proj, s_proj, b_proj = QuantParams(
+                self.dim, use_bias=True, name="proj")(C)
+            # explicit flash_blocks win (they also pin the unfused path's kv
+            # chunking — SAME block_kv is what makes fused≡unfused bitwise);
+            # otherwise the committed autotune table for this geometry
+            act_dt = jnp.int8 if self.quant == "w8a8" else self.dtype
+            blocks = self.flash_blocks or tuning.attn_blocks(
+                N, C, self.num_heads, act_dt)
+            out = fused_trunk_attention(
+                x.astype(self.dtype), w_qkv, s_qkv, b_qkv,
+                w_proj, s_proj, b_proj,
+                num_heads=self.num_heads, scale=scale,
+                block_q=blocks[0], block_kv=blocks[1],
+                mode="w8a8" if self.quant == "w8a8" else "pallas")
+            out = nn.Dropout(self.proj_drop, deterministic=deterministic)(out)
+            return out, None
 
         if self.quant:
             from ddim_cold_tpu.ops.quant import QuantDense
@@ -190,11 +285,6 @@ class Attention(nn.Module):
         qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B, N, H, hd)
 
-        # Flash/ring paths never materialize the O(N²) weights, so they
-        # require inactive attention-dropout (else fall back to einsum) and
-        # no weight probing.
-        weightless_ok = not need_weights and (deterministic or self.attn_drop == 0.0)
-        seq_parallel = self.seq_mesh is not None and self.seq_axis is not None
         if seq_parallel and not need_weights and not weightless_ok:
             # falling back to dense here would silently materialize the full
             # O(N²) global attention matrix — the exact thing sp exists to
@@ -323,6 +413,7 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # routing impl: "einsum" | "index" (moe.py)
     quant: Optional[str] = None  # w8a16 trunk denses (ops/quant.py)
+    fused: bool = False  # fused trunk kernels (Attention + Mlp megakernels)
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
@@ -352,6 +443,7 @@ class Block(nn.Module):
             seq_valid_len=self.seq_valid_len,
             seq_varying_axes=self.seq_varying_axes,
             quant=self.quant,
+            fused=self.fused,
             name="attn",
         )(ln("norm1")(x), deterministic=deterministic,
           need_weights=return_attention)
@@ -396,6 +488,7 @@ class Block(nn.Module):
                 drop=self.drop,
                 dtype=self.dtype,
                 quant=self.quant,
+                fused=self.fused,
                 name="mlp",
             )
         y = mlp(ln("norm2")(x), deterministic=deterministic)
@@ -533,6 +626,12 @@ class DiffusionViT(nn.Module):
     # "xla" | "pallas" = per-output-channel int8 qkv/proj/fc1/fc2 consumed
     # from a quantize_params tree; embeddings/norms/patch/head stay float.
     # Part of the module hash, so jit/AOT program caches key on it.
+    fused: bool = False  # fused sampler-trunk megakernels (inference): with
+    # quant="pallas"/"w8a8" the attention runs qkv-dequant → flash → proj as
+    # ONE kernel and the Mlp as another (ops/flash_attention.py, ops/quant.py);
+    # with quant=None only the float fused Mlp applies. Declares the SAME
+    # param leaves as the unfused composition — one tree serves both — and
+    # the training/probe/sp paths silently fall back to it.
 
     @property
     def num_patches(self) -> int:
@@ -616,6 +715,12 @@ class DiffusionViT(nn.Module):
                 # per-layer scale axis the codec doesn't model; quant serves
                 # the unrolled inference path (which the samplers use)
                 raise ValueError("quant requires scan_blocks=False")
+        if self.fused and self.quant == "xla":
+            raise ValueError(
+                "fused=True requests the Pallas fused trunk kernels but "
+                "quant='xla' explicitly opts out of Pallas — use "
+                "quant='pallas' or 'w8a8' (or quant=None for the float "
+                "fused Mlp alone)")
         if skip_blocks is not None or capture_split is not None:
             if self.scan_blocks:
                 raise ValueError(
@@ -759,6 +864,7 @@ class DiffusionViT(nn.Module):
                 num_experts=self.num_experts,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_dispatch=self.moe_dispatch,
+                fused=self.fused,  # quant is refused above — float fused Mlp
                 # the shell's field module binds to THIS scope, not the
                 # shell's — name it so params land under "blocks"
                 name="blocks",
@@ -812,6 +918,7 @@ class DiffusionViT(nn.Module):
                     moe_capacity_factor=self.moe_capacity_factor,
                     moe_dispatch=self.moe_dispatch,
                     quant=self.quant,
+                    fused=self.fused,
                 )
                 probe = (return_attention_layer is not None
                          and i == return_attention_layer % self.depth)

@@ -47,7 +47,10 @@ REGISTERED_SCOPES = (
     "flash_attention/fwd",
     "flash_attention/dq",
     "flash_attention/dkv",
+    "flash_attention/fused_qkv",
+    "flash_attention/fused_proj",
     "dequant_matmul/pallas",
+    "mlp/pallas",
     "sp/ring_exchange",
     "sp/all_to_all_gather",
     "sp/all_to_all_scatter",

@@ -65,6 +65,10 @@ BENCH_CHECKS = (
     # regression even when throughput holds
     ("submetrics.memory.peak_hbm_gb", "lower"),
     ("submetrics.memory.max_kernel_vmem_mb", "lower"),
+    # fused-trunk leg (bench --fusion): the fused program's throughput and
+    # its advantage over the unfused w8a16 composition must not decay
+    ("submetrics.fusion.fused.img_per_sec", "higher"),
+    ("submetrics.fusion.speedup", "higher"),
 )
 MULTICHIP_CHECKS = (
     ("rc", "zero"),

@@ -50,9 +50,11 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 
 #: kernel revision stamped into bench records (scripts/r05_stage_done.py keys
 #: re-measurement off it): "bf16-gemm-v2" = GEMMs in input dtype with f32 MXU
-#: accumulation (the r05 change); the original always-f32-GEMM kernel — the
-#: one every pre-r05b hardware record measured — had no stamp.
-KERNEL_REV = "bf16-gemm-v2"
+#: accumulation (the r05 change); "fused-trunk-v3" adds the quant-aware fused
+#: trunk attention (qkv dequant-GEMM as in-kernel producer, proj GEMM as
+#: in-kernel consumer — see :func:`fused_trunk_attention`). The unfused
+#: kernels are untouched by v3: their numerics are bit-identical to v2.
+KERNEL_REV = "fused-trunk-v3"
 
 #: tuned (block_q, block_kv) for the N=2501 north-star flash leg: the r05
 #: on-chip sweep put full-sequence kv blocks ahead of streamed ones (512×4096:
@@ -484,3 +486,233 @@ def _flash_bwd(scale, block_q, block_kv, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused quant-aware trunk attention (qkv producer → flash → proj consumer)
+# ---------------------------------------------------------------------------
+
+def _fused_trunk_kernel(*refs, heads: int, head_dim: int, scale: float,
+                        n_valid: int, block_kv: int, n_kv: int,
+                        qkv_bias: bool, proj_bias: bool, w8a8: bool):
+    """One (batch, q-block, kv-block) program of the fused sampler-trunk
+    attention: the w8a16 qkv dequant-matmul runs INSIDE the kernel as the
+    producer (int8 weights + per-column scales staged in VMEM, dequantized at
+    the MXU feed), the online softmax folds the kv chunk exactly like
+    :func:`_fwd_kernel`, and on the last chunk the proj dequant-matmul
+    consumes the attention output block in place — the (B, N, 3C) qkv and
+    (B, N, C) context activations never round-trip through HBM.
+
+    Numerics mirror the unfused ``QuantDense → flash_attention → QuantDense``
+    composition term for term (same dot shapes over the same K reductions,
+    same f32 scale/bias epilogues, same compute-dtype casts, same online-
+    softmax update order), so the fused path is bitwise at f32 and within
+    round-off at bf16 — tests/test_fusion.py pins both.
+
+    ``w8a8=True`` switches the two trunk GEMMs to int8×int8 with int32 MXU
+    accumulation: the x activations arrive pre-quantized (per-tensor dynamic
+    scale folded into the qkv scales by the wrapper) and the attention output
+    is requantized per q-block before the proj GEMM. Attention itself
+    (softmax, p·v) stays in the compute dtype — only the trunk GEMM feeds are
+    int8. Gated behind the paired-FID ``quantized_sampler_guard``.
+    """
+    bqkv_ref = bp_ref = None
+    if qkv_bias and proj_bias:
+        (xq_ref, xkv_ref, wqkv_ref, sqkv_ref, bqkv_ref, wp_ref, sp_ref,
+         bp_ref, o_ref, q_s, acc_s, m_s, l_s) = refs
+    elif qkv_bias:
+        (xq_ref, xkv_ref, wqkv_ref, sqkv_ref, bqkv_ref, wp_ref, sp_ref,
+         o_ref, q_s, acc_s, m_s, l_s) = refs
+    elif proj_bias:
+        (xq_ref, xkv_ref, wqkv_ref, sqkv_ref, wp_ref, sp_ref, bp_ref,
+         o_ref, q_s, acc_s, m_s, l_s) = refs
+    else:
+        (xq_ref, xkv_ref, wqkv_ref, sqkv_ref, wp_ref, sp_ref,
+         o_ref, q_s, acc_s, m_s, l_s) = refs
+    kv_i = pl.program_id(2)
+    C = heads * head_dim
+    cdt = q_s.dtype
+    w_all = wqkv_ref[...]   # (C, 3C) int8
+    s_all = sqkv_ref[0]     # (3C,) f32 (w8a8: pre-folded with the act scale)
+    b_all = bqkv_ref[0] if qkv_bias else None
+
+    def project(x, w_cols, s_cols, b_cols):
+        # one column range of the qkv dequant-matmul — per output element the
+        # SAME K=C reduction the unfused kernel computes, so slicing the
+        # weight columns (vs slicing the full qkv output) is value-identical
+        if w8a8:
+            y = jax.lax.dot_general(
+                x, w_cols, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32) * s_cols
+        else:
+            y = jax.lax.dot_general(
+                x, w_cols.astype(cdt), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * s_cols
+        if b_cols is not None:
+            y = y + b_cols
+        return y.astype(cdt)  # the QuantDense epilogue cast
+
+    @pl.when(kv_i == 0)
+    def _init():
+        # q projection once per (batch, q-block); carried across kv chunks
+        q_s[...] = project(xq_ref[0], w_all[:, :C], s_all[:C],
+                           b_all[:C] if qkv_bias else None)
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # k/v projection for THIS kv chunk — recomputed per chunk, the price of
+    # never writing the (B, N, 2C) k/v activation to HBM (2·bkv·C·C MACs per
+    # chunk vs a (B, N, 2C) HBM round-trip per layer)
+    kv = project(xkv_ref[0], w_all[:, C:], s_all[C:],
+                 b_all[C:] if qkv_bias else None)  # (bkv, 2C) cdt
+
+    for h in range(heads):
+        lo, hi = h * head_dim, (h + 1) * head_dim
+        q_h = q_s[:, lo:hi]          # (bq, hd) cdt
+        k_h = kv[:, lo:hi]           # (bkv, hd)
+        v_h = kv[:, C + lo:C + hi]
+        # identical update math to _fwd_kernel — the zero-padded head-dim
+        # lanes of the unfused path contribute exact +0.0 partial products,
+        # so the hd-width reduction here is bitwise the Dp-width one
+        logits = jax.lax.dot_general(
+            q_h, k_h, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bkv) f32
+        col = kv_i * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < n_valid, logits, _NEG_INF)
+        m_prev = jnp.max(m_s[h], axis=-1, keepdims=True)  # (bq, 1) replicated
+        l_prev = jnp.max(l_s[h], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.dot(p.astype(v_h.dtype), v_h,
+                     preferred_element_type=jnp.float32)
+        acc_s[:, lo:hi] = acc_s[:, lo:hi] * alpha + pv
+        m_s[h] = jnp.broadcast_to(m_new, m_s.shape[1:])
+        l_s[h] = jnp.broadcast_to(l_new, l_s.shape[1:])
+
+    @pl.when(kv_i == n_kv - 1)
+    def _emit():
+        outs = []
+        for h in range(heads):
+            lo, hi = h * head_dim, (h + 1) * head_dim
+            l = jnp.max(l_s[h], axis=-1, keepdims=True)
+            outs.append((acc_s[:, lo:hi] / l).astype(cdt))
+        attn = jnp.concatenate(outs, axis=-1)  # (bq, C) cdt, head-major cols
+        if w8a8:
+            amax = jnp.max(jnp.abs(attn.astype(jnp.float32)))
+            qs = jnp.where(amax > 0, amax / 127.0, 1.0)
+            ai = jnp.clip(jnp.round(attn.astype(jnp.float32) / qs),
+                          -127.0, 127.0).astype(jnp.int8)
+            y = jax.lax.dot_general(
+                ai, wp_ref[...], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+            y = y * (qs * sp_ref[0])
+        else:
+            y = jax.lax.dot_general(
+                attn, wp_ref[...].astype(cdt), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * sp_ref[0]
+        if bp_ref is not None:
+            # proj bias fused at the scale multiply — the same contraction
+            # point as the unfused QuantDense epilogue (quant._mm_kernel)
+            y = y + bp_ref[0]
+        o_ref[0] = y  # f32; the wrapper casts to the compute dtype
+
+
+def fused_trunk_attention(x, w_qkv, s_qkv, b_qkv, w_proj, s_proj, b_proj, *,
+                          num_heads: int, scale: float, block_q: int = 512,
+                          block_kv: int = 1024, mode: str = "pallas"):
+    """Quant-aware fused trunk attention: ``x → qkv dequant-GEMM → flash
+    attention → proj dequant-GEMM`` as ONE Pallas kernel (inference only —
+    the sampler hot path; training keeps the unfused composition and its
+    custom VJP).
+
+    ``x``: (B, N, C) activations in the compute dtype; ``w_qkv``/``w_proj``:
+    int8 (C, 3C)/(C, C) weights with f32 per-output-column scales (the
+    ops/quant.py codec); biases f32 or None. Returns (B, N, C) in ``x``'s
+    dtype — the full QuantDense epilogue (scale, bias, cast) included.
+    ``mode="w8a8"`` additionally quantizes the activations (per-tensor
+    dynamic scale, int8×int8 trunk GEMMs). Off TPU/CPU, falls back to the
+    unfused XLA composition, same policy as :func:`flash_attention`.
+    """
+    from ddim_cold_tpu.ops import quant as _quant
+
+    B, N, C = x.shape
+    head_dim = C // num_heads
+    if C % num_heads:
+        raise ValueError(f"embed dim {C} must divide by heads {num_heads}")
+    if mode not in ("pallas", "w8a8"):
+        raise ValueError(f"fused attention mode must be 'pallas' or 'w8a8', "
+                         f"got {mode!r}")
+    w8a8 = mode == "w8a8"
+    if not _use_kernel():
+        # unfused XLA composition (GPU etc.) — the same epilogues
+        xla_mode = "w8a8" if w8a8 else "xla"
+        qkv = _quant.dequant_matmul(x, w_qkv, s_qkv, bias=b_qkv,
+                                    mode=xla_mode)
+        qkv = qkv.astype(x.dtype).reshape(B, N, 3, num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = _dense_attention_f32(q, k, v, scale)[1].astype(x.dtype)
+        y = _quant.dequant_matmul(out.reshape(B, N, C), w_proj, s_proj,
+                                  bias=b_proj, mode=xla_mode)
+        return y.astype(x.dtype)
+
+    if w8a8:
+        xi, xs = _quant.quantize_act(x)
+        x_in = xi
+        s_eff = s_qkv.astype(jnp.float32) * xs  # per-tensor act scale folded
+    else:
+        x_in, s_eff = x, s_qkv.astype(jnp.float32)
+    bq = tiling.legal_block(block_q, N, x_in.dtype)
+    bkv = tiling.legal_block(block_kv, N, x_in.dtype)
+    xq = _pad_to(x_in, 1, bq)
+    xkv = _pad_to(x_in, 1, bkv)
+    n_q, n_kv = xq.shape[1] // bq, xkv.shape[1] // bkv
+
+    C3 = 3 * C
+    inputs = [xq, xkv, w_qkv, s_eff[None, :]]
+    in_specs = [
+        pl.BlockSpec((1, bq, C), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bkv, C), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((C, C3), lambda b, i, j: (0, 0)),
+        pl.BlockSpec((1, C3), lambda b, i, j: (0, 0)),
+    ]
+    if b_qkv is not None:
+        inputs.append(b_qkv.astype(jnp.float32)[None, :])
+        in_specs.append(pl.BlockSpec((1, C3), lambda b, i, j: (0, 0)))
+    inputs += [w_proj, s_proj.astype(jnp.float32)[None, :]]
+    in_specs += [
+        pl.BlockSpec((C, C), lambda b, i, j: (0, 0)),
+        pl.BlockSpec((1, C), lambda b, i, j: (0, 0)),
+    ]
+    if b_proj is not None:
+        inputs.append(b_proj.astype(jnp.float32)[None, :])
+        in_specs.append(pl.BlockSpec((1, C), lambda b, i, j: (0, 0)))
+    kernel = functools.partial(
+        _fused_trunk_kernel, heads=num_heads, head_dim=head_dim, scale=scale,
+        n_valid=N, block_kv=bkv, n_kv=n_kv, qkv_bias=b_qkv is not None,
+        proj_bias=b_proj is not None, w8a8=w8a8)
+    with profiling.scope("flash_attention/fused_qkv"):
+        out = pl.pallas_call(
+            kernel,
+            grid=(B, n_q, n_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, bq, C), lambda b, i, j: (b, i, 0)),
+            out_shape=_sds((B, n_q * bq, C), jnp.float32, x),
+            scratch_shapes=[
+                pltpu.VMEM((bq, C), x.dtype),        # projected q block
+                pltpu.VMEM((bq, C), jnp.float32),     # per-head output acc
+                pltpu.VMEM((num_heads, bq, _LANE), jnp.float32),  # running max
+                pltpu.VMEM((num_heads, bq, _LANE), jnp.float32),  # running den
+            ],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+            interpret=jax.default_backend() == "cpu",
+        )(*inputs)
+    with profiling.scope("flash_attention/fused_proj"):
+        # scale + bias already applied in-kernel; only slice off the q-block
+        # padding and cast back to the compute dtype
+        return out[:, :N].astype(x.dtype)

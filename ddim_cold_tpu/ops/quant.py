@@ -67,10 +67,17 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 #: scripts/perf_tables.py renders it and stale-record protection keys
 #: re-measurement off it). "w8a16-pcq-v1" = per-output-channel symmetric
 #: int8 weights, [−127, 127] codes, f32-accumulated dequant matmul.
-QUANT_REV = "w8a16-pcq-v1"
+#: "w8a16-fused-v2" adds the fused trunk kernels (mlp_pallas here, the fused
+#: attention in ops/flash_attention.py) and the optional "w8a8" activation
+#: mode (per-tensor dynamic int8 activations, int32 MXU accumulation). The
+#: weight codec is unchanged from v1 — int8 param trees need no re-quantize.
+QUANT_REV = "w8a16-fused-v2"
 
-#: dequant_matmul modes a model/SamplerConfig may request
-QUANT_MODES = ("xla", "pallas")
+#: dequant_matmul modes a model/SamplerConfig may request. "w8a8" = int8
+#: weights AND int8 activations (per-tensor dynamic scale, round-to-nearest
+#: [−127, 127] codes) — FID-guard gated (eval/fid.quantized_sampler_guard);
+#: the weight tree is the same w8a16 tree, only the GEMM feed changes.
+QUANT_MODES = ("xla", "pallas", "w8a8")
 
 #: trunk modules whose ``kernel`` is quantized, keyed by parent module name —
 #: the same (parent, leaf) addressing parallel/sharding.py's _spec_for uses.
@@ -204,17 +211,54 @@ def calibrate(params) -> dict:
 # w8a16 matmul — XLA path
 # ---------------------------------------------------------------------------
 
-def _dequant_matmul_xla(x: jax.Array, w_int8: jax.Array,
-                        scale: jax.Array) -> jax.Array:
+def _dequant_matmul_xla(x: jax.Array, w_int8: jax.Array, scale: jax.Array,
+                        bias: Optional[jax.Array] = None) -> jax.Array:
     """``x @ (w_int8 * scale)`` without materializing the dequantized weight:
     the int8→activation-dtype convert fuses into the matmul operand read and
-    the per-column scale into the f32 epilogue. Accumulation is f32
-    (``preferred_element_type``), the w8a16 contract."""
+    the per-column scale (+ optional bias) into the f32 epilogue.
+    Accumulation is f32 (``preferred_element_type``), the w8a16 contract."""
     w = w_int8.astype(x.dtype)
     y = jax.lax.dot_general(
         x, w, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    return y * scale
+    y = y * scale
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# w8a8 — dynamic activation quantization
+# ---------------------------------------------------------------------------
+
+def quantize_act(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-TENSOR symmetric dynamic int8 quantization of an activation:
+    ``scale = max|x|/127`` (1.0 for an all-zero tensor), round-to-nearest
+    codes clipped to [−127, 127] — the activation half of the "w8a8" mode.
+    Per-tensor (not per-channel): the scale is one scalar folded into the
+    weight's per-column scales at the GEMM epilogue, so the int8×int8 MXU
+    path needs no extra per-element work."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(xf / scale), -127.0, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def _dequant_matmul_w8a8(x: jax.Array, w_int8: jax.Array, scale: jax.Array,
+                         bias: Optional[jax.Array] = None) -> jax.Array:
+    """int8×int8 GEMM with int32 MXU accumulation: activations quantized
+    on the fly (per-tensor dynamic scale), both scales (+ optional bias)
+    applied once in the f32 epilogue. The unfused "w8a8" reference the
+    fused kernels are guard-checked against."""
+    xi, xs = quantize_act(x)
+    y = jax.lax.dot_general(
+        xi, w_int8, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = y.astype(jnp.float32) * (xs * scale)
+    if bias is not None:
+        y = y + bias
+    return y
 
 
 # ---------------------------------------------------------------------------
@@ -228,12 +272,26 @@ def _use_kernel() -> bool:
     return jax.default_backend() in ("tpu", "cpu")
 
 
-def _mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int):
+def _mm_kernel(*refs, n_k: int, has_bias: bool):
     """One (m-tile, n-tile, k-chunk) program: dequantize this int8 weight
     chunk to the activation dtype in VMEM, fold its partial product into the
-    f32 accumulator, and on the last chunk apply the per-column scale once
-    and emit. K is the innermost (sequential) grid axis, so the scratch
-    accumulator carries across chunks of one output tile."""
+    f32 accumulator, and on the last chunk apply the per-column scale (and
+    bias, when the caller fuses it) once and emit. K is the innermost
+    (sequential) grid axis, so the scratch accumulator carries across chunks
+    of one output tile.
+
+    The bias rides INSIDE the kernel (not as a caller-side epilogue) so the
+    ``acc·s + b`` contraction happens at the same point in every path: the
+    fused trunk kernels keep their scale-multiply and bias-add adjacent, and
+    XLA:CPU contracts adjacent multiply+add into a single-rounding fma —
+    with the add on the other side of the kernel boundary the unfused path
+    would round twice and the f32 bitwise-parity contract would break by one
+    ulp (tests/test_fusion.py pins the contract)."""
+    if has_bias:
+        x_ref, w_ref, s_ref, b_ref, o_ref, acc_ref = refs
+    else:
+        x_ref, w_ref, s_ref, o_ref, acc_ref = refs
+        b_ref = None
     k_i = pl.program_id(2)
 
     @pl.when(k_i == 0)
@@ -247,7 +305,10 @@ def _mm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int):
 
     @pl.when(k_i == n_k - 1)
     def _emit():
-        o_ref[...] = acc_ref[...] * s_ref[0]
+        y = acc_ref[...] * s_ref[0]
+        if has_bias:
+            y = y + b_ref[0]
+        o_ref[...] = y
 
 
 def _round_up(n: int, m: int) -> int:
@@ -265,6 +326,7 @@ def _pad_axis(x: jax.Array, axis: int, to: int) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_n", "block_k"))
 def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
+                           bias: Optional[jax.Array] = None,
                            *, block_m: int = 256, block_n: int = 512,
                            block_k: int = 512) -> jax.Array:
     """Fused dequant-matmul on a 2-D ``(M, K) @ (K, N)`` problem.
@@ -285,21 +347,29 @@ def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
     bm = tiling.legal_block(block_m, M, x2d.dtype)
     bn = tiling.legal_block(block_n, N, jnp.float32, lane=True)
     bk = tiling.legal_block(block_k, K, x2d.dtype, lane=True,
-                            min_unit=tiling.sublane_unit(jnp.int8))
+                            min_unit=jnp.int8)
     xp = _pad_axis(_pad_axis(x2d, 0, _round_up(M, bm)), 1, _round_up(K, bk))
     wp = _pad_axis(_pad_axis(w_int8, 0, _round_up(K, bk)), 1, _round_up(N, bn))
     sp = _pad_axis(scale.astype(jnp.float32)[None, :], 1, _round_up(N, bn))
     n_k = xp.shape[1] // bk
 
+    inputs = [xp, wp, sp]
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+        pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+    ]
+    if bias is not None:
+        inputs.append(_pad_axis(bias.astype(jnp.float32)[None, :], 1,
+                                _round_up(N, bn)))
+        in_specs.append(pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)))
+
     with profiling.scope("dequant_matmul/pallas"):
         out = pl.pallas_call(
-            functools.partial(_mm_kernel, n_k=n_k),
+            functools.partial(_mm_kernel, n_k=n_k,
+                              has_bias=bias is not None),
             grid=(xp.shape[0] // bm, wp.shape[1] // bn, n_k),
-            in_specs=[
-                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-                pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
             out_shape=jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]),
                                            jnp.float32),
@@ -307,7 +377,7 @@ def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
             compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=jax.default_backend() == "cpu",
-        )(xp, wp, sp)
+        )(*inputs)
     return out[:M, :N]
 
 
@@ -316,22 +386,204 @@ def _dequant_matmul_pallas(x2d: jax.Array, w_int8: jax.Array, scale: jax.Array,
 # ---------------------------------------------------------------------------
 
 def dequant_matmul(x: jax.Array, w_int8: jax.Array, scale: jax.Array,
-                   *, mode: str = "xla") -> jax.Array:
-    """w8a16 matmul over the last axis of ``x``: ``x @ (w_int8·scale)`` with
-    f32 accumulation; returns f32 (callers add bias in f32 and cast to the
-    compute dtype — one epilogue for both modes). ``mode="pallas"`` runs the
-    fused kernel where capability allows and silently takes the XLA form
-    elsewhere, exactly the flash-attention fallback policy."""
+                   *, bias: Optional[jax.Array] = None,
+                   mode: str = "xla") -> jax.Array:
+    """Quantized matmul over the last axis of ``x``: ``x @ (w_int8·scale)
+    [+ bias]`` with f32 accumulation; returns f32 (callers cast to the
+    compute dtype — one epilogue for every mode). The bias is fused into
+    the kernel epilogue rather than added by the caller so the scale·acc+b
+    contraction point is identical across the unfused and fused trunk paths
+    (see ``_mm_kernel``). ``mode="pallas"`` runs the fused w8a16 kernel
+    where capability allows and silently takes the XLA form elsewhere,
+    exactly the flash-attention fallback policy. ``mode="w8a8"`` quantizes
+    the activation too (per-tensor dynamic scale, int8×int8 GEMM) — the
+    unfused reference for the fused w8a8 kernels."""
     if mode not in QUANT_MODES:
         raise ValueError(f"quant mode must be one of {QUANT_MODES}, got {mode!r}")
     if w_int8.dtype != jnp.int8:
         raise ValueError(f"w_int8 must be int8, got {w_int8.dtype}")
+    if mode == "w8a8":
+        return _dequant_matmul_w8a8(x, w_int8, scale, bias)
     if mode == "pallas" and _use_kernel():
         lead = x.shape[:-1]
         y = _dequant_matmul_pallas(x.reshape(-1, x.shape[-1]), w_int8,
-                                   scale)
+                                   scale, bias)
         return y.reshape(*lead, w_int8.shape[-1])
-    return _dequant_matmul_xla(x, w_int8, scale)
+    return _dequant_matmul_xla(x, w_int8, scale, bias)
+
+
+# ---------------------------------------------------------------------------
+# fused Mlp kernel (matmul → bias → exact GELU → matmul)
+# ---------------------------------------------------------------------------
+
+def _mlp_kernel(*refs, quant: bool, w8a8: bool, has_b2: bool, cdt):
+    """One M-tile program of the fused Mlp: fc1 GEMM into the f32 scratch
+    accumulator, bias + exact (erf) GELU in VMEM, fc2 GEMM straight out —
+    the (M, hidden) activation never exists in HBM. Weights ride whole-array
+    VMEM blocks (trunk Mlp weights are ≤ a few hundred KiB); ``quant``
+    selects int8 weights dequantized at the MXU feed (w8a16), ``w8a8``
+    additionally feeds int8 activations (int32 accumulation, per-tensor
+    scale pre-folded by the wrapper; the hidden activation requantizes per
+    M-tile). Numerics mirror the unfused ``Dense → gelu → Dense`` /
+    ``QuantDense → gelu → QuantDense`` compositions term for term."""
+    b2_ref = None
+    if quant and has_b2:
+        (x_ref, w1_ref, s1_ref, b1_ref, w2_ref, s2_ref, b2_ref,
+         o_ref, acc_ref) = refs
+    elif quant:
+        x_ref, w1_ref, s1_ref, b1_ref, w2_ref, s2_ref, o_ref, acc_ref = refs
+    elif has_b2:
+        x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref = refs
+        s1_ref = s2_ref = None
+    else:
+        x_ref, w1_ref, b1_ref, w2_ref, o_ref, acc_ref = refs
+        s1_ref = s2_ref = None
+    x = x_ref[...]  # (bm, K) compute dtype (w8a8: int8)
+    if w8a8:
+        y1 = jax.lax.dot_general(
+            x, w1_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * s1_ref[0]
+    elif quant:
+        y1 = jax.lax.dot_general(
+            x, w1_ref[...].astype(cdt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * s1_ref[0]
+    else:
+        y1 = jax.lax.dot_general(
+            x, w1_ref[...].astype(cdt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    acc_ref[...] = y1 + b1_ref[0]  # f32 accumulator, f32 bias epilogue
+    h = jax.nn.gelu(acc_ref[...].astype(cdt), approximate=False)
+    if w8a8:
+        amax = jnp.max(jnp.abs(h.astype(jnp.float32)))
+        hs = jnp.where(amax > 0, amax / 127.0, 1.0)
+        hi = jnp.clip(jnp.round(h.astype(jnp.float32) / hs),
+                      -127.0, 127.0).astype(jnp.int8)
+        y2 = jax.lax.dot_general(
+            hi, w2_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        y2 = y2 * (hs * s2_ref[0])
+    elif quant:
+        y2 = jax.lax.dot_general(
+            h, w2_ref[...].astype(cdt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * s2_ref[0]
+    else:
+        y2 = jax.lax.dot_general(
+            h, w2_ref[...].astype(cdt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if has_b2:
+        # fc2 bias fused at the scale-multiply (same contraction point as
+        # the unfused QuantDense / Dense epilogue — see _mm_kernel)
+        y2 = y2 + b2_ref[0]
+    o_ref[...] = y2  # f32; the wrapper casts to the compute dtype
+
+
+def mlp_pallas(x, w1, b1, w2, b2, *, scale1=None, scale2=None,
+               mode: Optional[str] = None, block_m: int = 256) -> jax.Array:
+    """Fused Mlp trunk ``x @ w1 + b1 → exact GELU → @ w2 + b2`` as ONE
+    Pallas kernel — replaces the two ``nn.Dense`` + ``nn.gelu`` ops in
+    ``Mlp.__call__`` behind the same capability gating as the flash kernel.
+
+    ``mode=None``: float weights (``w1``/``w2`` are the dense kernels).
+    ``mode="pallas"``: w8a16 — int8 weights with per-column f32 scales.
+    ``mode="w8a8"``: int8 weights AND per-tensor dynamic int8 activations.
+    Returns ``x.dtype``, full bias epilogues included; off TPU/CPU takes the
+    unfused XLA composition (same fallback policy as flash/dequant)."""
+    if mode not in (None, "pallas", "w8a8"):
+        raise ValueError(f"mlp_pallas mode must be None, 'pallas' or "
+                         f"'w8a8', got {mode!r}")
+    quant = mode is not None
+    if quant and (scale1 is None or scale2 is None):
+        raise ValueError(f"mode={mode!r} needs scale1/scale2 (the w8a16 "
+                         "per-column weight scales)")
+    cdt = x.dtype
+    lead, K = x.shape[:-1], x.shape[-1]
+    Hf, Nout = w1.shape[-1], w2.shape[-1]
+    if not _use_kernel():
+        # unfused XLA composition (GPU etc.) — the same epilogues
+        if mode is None:
+            y1 = jax.lax.dot_general(
+                x, w1.astype(cdt), (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) + b1
+        else:
+            mm = _dequant_matmul_w8a8 if mode == "w8a8" else _dequant_matmul_xla
+            y1 = mm(x, w1, scale1, b1)
+        h = jax.nn.gelu(y1.astype(cdt), approximate=False)
+        if mode is None:
+            y2 = jax.lax.dot_general(
+                h, w2.astype(cdt), (((h.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if b2 is not None:
+                y2 = y2 + b2
+        else:
+            y2 = mm(h, w2, scale2, b2)
+        return y2.astype(cdt)
+
+    if mode == "w8a8":
+        xi, xs = quantize_act(x)
+        x2d = xi.reshape(-1, K)
+        s1_eff = scale1.astype(jnp.float32) * xs
+    else:
+        x2d = x.reshape(-1, K)
+        s1_eff = None if scale1 is None else scale1.astype(jnp.float32)
+    M = x2d.shape[0]
+    bm = tiling.legal_block(block_m, M, x2d.dtype)
+    xp = _pad_axis(x2d, 0, _round_up(M, bm))
+
+    inputs = [xp, w1]
+    in_specs = [pl.BlockSpec((bm, K), lambda i: (i, 0)),
+                pl.BlockSpec((K, Hf), lambda i: (0, 0))]
+    if quant:
+        inputs.append(s1_eff[None, :])
+        in_specs.append(pl.BlockSpec((1, Hf), lambda i: (0, 0)))
+    inputs.append(b1.astype(jnp.float32)[None, :])
+    in_specs.append(pl.BlockSpec((1, Hf), lambda i: (0, 0)))
+    inputs.append(w2)
+    in_specs.append(pl.BlockSpec((Hf, Nout), lambda i: (0, 0)))
+    if quant:
+        inputs.append(scale2.astype(jnp.float32)[None, :])
+        in_specs.append(pl.BlockSpec((1, Nout), lambda i: (0, 0)))
+    if b2 is not None:
+        inputs.append(b2.astype(jnp.float32)[None, :])
+        in_specs.append(pl.BlockSpec((1, Nout), lambda i: (0, 0)))
+
+    with profiling.scope("mlp/pallas"):
+        out = pl.pallas_call(
+            functools.partial(_mlp_kernel, quant=quant,
+                              w8a8=mode == "w8a8",
+                              has_b2=b2 is not None, cdt=cdt),
+            grid=(xp.shape[0] // bm,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((bm, Nout), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((xp.shape[0], Nout), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((bm, Hf), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=jax.default_backend() == "cpu",
+        )(*inputs)
+    return out[:M].astype(cdt).reshape(*lead, Nout)
+
+
+class QuantParams(nn.Module):
+    """Declares the ``{w_int8, scale[, bias]}`` leaves of a :class:`QuantDense`
+    WITHOUT computing the matmul — the fused trunk kernels consume the raw
+    leaves. Same param names, shapes, dtypes and initializers as QuantDense
+    (and the same module path when given the same ``name``), so a fused and
+    an unfused model share one param tree interchangeably and
+    ``quantize_params`` output loads into either."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        w_int8 = self.param("w_int8", nn.initializers.zeros_init(),
+                            (in_features, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        return w_int8, scale, bias
 
 
 class QuantDense(nn.Module):
@@ -352,9 +604,11 @@ class QuantDense(nn.Module):
                             (x.shape[-1], self.features), jnp.int8)
         scale = self.param("scale", nn.initializers.ones_init(),
                            (self.features,), jnp.float32)
-        y = dequant_matmul(x.astype(self.dtype), w_int8, scale, mode=self.mode)
-        if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros_init(),
-                              (self.features,), jnp.float32)
-            y = y + bias
+        bias = (self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        # bias fused into the matmul epilogue — the contraction point must
+        # match the fused trunk kernels' (see _mm_kernel docstring)
+        y = dequant_matmul(x.astype(self.dtype), w_int8, scale, bias=bias,
+                           mode=self.mode)
         return y.astype(self.dtype)

@@ -41,16 +41,34 @@ def sublane_unit(dtype) -> int:
             f"no TPU tile rule for {np.dtype(dtype)} ({bits}-bit)") from None
 
 
+def _unit_of(spec) -> int:
+    """One ``min_unit`` constraint → its divisibility unit: an int passes
+    through; anything else is treated as a dtype whose SUBLANE unit the
+    co-tiled operand imposes (the lane unit is dtype-independent — callers
+    fold it via ``lane=True`` on the primary operand)."""
+    if isinstance(spec, (int, np.integer)):
+        u = int(spec)
+        if u < 1:
+            raise ValueError(f"min_unit must be >= 1, got {spec!r}")
+        return u
+    return sublane_unit(spec)
+
+
 def legal_block(requested: int, dim: int, dtype, *, lane: bool = False,
-                min_unit: int = 1) -> int:
+                min_unit=1) -> int:
     """Clamp a requested Pallas block size to a Mosaic-legal one for an
     array dim of ``dim`` elements of ``dtype``.
 
     ``lane=False`` legalizes a sublane (second-minor) block dim,
-    ``lane=True`` a lane (minor) one. ``min_unit`` folds in an extra
-    divisibility constraint when one block size tiles two arrays of
-    different dtypes (e.g. the dequant matmul's K block is the activation's
-    lane dim AND the int8 weight's sublane dim).
+    ``lane=True`` a lane (minor) one. ``min_unit`` folds in extra
+    divisibility constraints when one block size tiles several arrays of
+    different dtypes: an int (a raw unit), a dtype (that dtype's SUBLANE
+    unit), or a sequence of either. The fused trunk kernels hit the
+    dual-dtype case head-on — the dequant matmul's K block is the f32/bf16
+    activation's lane dim AND the int8 weight's sublane dim, so BOTH the
+    128-lane and the 32-sublane constraints must hold in the one block spec
+    (previously each operand was legalized separately at the call site,
+    which cannot express the conjunction).
 
     Policy: round the request UP to the unit (never down — a shrunk block
     re-tiles the grid, a grown one only pads VMEM), then clamp to the
@@ -63,11 +81,14 @@ def legal_block(requested: int, dim: int, dtype, *, lane: bool = False,
     if dim < 1:
         raise ValueError(f"array dim must be >= 1, got {dim}")
     unit = LANE if lane else sublane_unit(dtype)
-    # int(): np.gcd promotes the lcm to np.int64, which would propagate into
-    # every grid entry computed from the block — Pallas treats a non-Python-
-    # int grid dim as DYNAMIC (DynamicGridDim), silently forfeiting the
-    # static-grid scheduling the kernels are written for (graftcheck P001
-    # proves all in-tree grids fully static)
-    unit = int(unit * min_unit // np.gcd(unit, min_unit))  # lcm
+    specs = min_unit if isinstance(min_unit, (tuple, list)) else (min_unit,)
+    for spec in specs:
+        extra = _unit_of(spec)
+        # int(): np.gcd promotes the lcm to np.int64, which would propagate
+        # into every grid entry computed from the block — Pallas treats a
+        # non-Python-int grid dim as DYNAMIC (DynamicGridDim), silently
+        # forfeiting the static-grid scheduling the kernels are written for
+        # (graftcheck P001 proves all in-tree grids fully static)
+        unit = int(unit * extra // np.gcd(unit, extra))  # lcm
     full = round_up(dim, unit)
     return min(round_up(requested, unit), full)

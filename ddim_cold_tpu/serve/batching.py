@@ -27,7 +27,7 @@ import numpy as np
 
 _SAMPLERS = ("ddim", "cold")
 _CACHE_MODES = ("delta", "full", "adaptive", "token")
-_QUANT_MODES = (None, "xla", "pallas")  # ops/quant.py QUANT_MODES + off
+_QUANT_MODES = (None, "xla", "pallas", "w8a8")  # ops/quant.py QUANT_MODES + off
 #: workloads.TASKS, duplicated as literals (this module is host-only —
 #: graftcheck A004 — and the workloads package imports jax); the two tuples
 #: are pinned equal by tests/test_workloads.py
@@ -60,7 +60,15 @@ class SamplerConfig:
     # dependent upper bound is enforced at program build, not here: this
     # module is host-only and never sees the model).
     quant: Optional[str] = None    # None = float params; "xla" | "pallas" =
-    # the w8a16 trunk (ops/quant.py) over the engine's int8 param tree
+    # the w8a16 trunk (ops/quant.py) over the engine's int8 param tree;
+    # "w8a8" additionally feeds int8 activations (per-tensor dynamic scale)
+    # — FID-guard gated (eval/fid.quantized_sampler_guard)
+    fused: bool = False            # fused sampler-trunk megakernels
+    # (models/vit.py fused=True): qkv-dequant → flash → proj as one Pallas
+    # kernel plus the fused Mlp kernel. Same param tree as unfused — but a
+    # DIFFERENT compiled program, so fused and unfused requests never
+    # coalesce. Requires quant != "xla" (pure-XLA mode has no kernels to
+    # fuse); f32 results are bitwise the unfused program's (tests pin it).
     task: str = "sample"           # "sample" = plain generation; an editing
     # task name (ddim_cold_tpu/workloads) selects that task's init builder
     # and — for "inpaint" — its per-step-constrained scan. Static: mixed
@@ -123,6 +131,12 @@ class SamplerConfig:
         if self.quant not in _QUANT_MODES:
             raise ValueError(f"quant must be one of {_QUANT_MODES}, "
                              f"got {self.quant!r}")
+        if self.fused and self.quant == "xla":
+            raise ValueError(
+                "fused=True requests the Pallas fused trunk kernels but "
+                "quant='xla' explicitly opts out of Pallas — use "
+                "quant='pallas' or 'w8a8' (or quant=None for the float "
+                "fused Mlp alone)")
         if self.task not in _TASKS:
             raise ValueError(f"task must be one of {_TASKS}, "
                              f"got {self.task!r}")

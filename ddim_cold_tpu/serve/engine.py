@@ -203,7 +203,7 @@ class Engine:
         # float tree — every quant dispatch reuses the same device buffers
         # (≈4× fewer trunk-param bytes over the link than the float tree).
         self._qparams = None
-        self._quant_models: dict = {}  # quant mode -> model clone (hash key)
+        self._quant_models: dict = {}  # (quant, fused) -> model clone
         self._pending: list[Request] = []               # guarded-by: _lock
         # rid -> unresolved Request (stall fail set)
         self._open: dict = {}                           # guarded-by: _lock
@@ -467,20 +467,23 @@ class Engine:
         return config.sp_mode
 
     def _model_for(self, config: SamplerConfig):
-        """The model variant a config's programs trace: ``quant``, the sp
-        mesh, and the sp axis names are all fields of the (hash-by-value)
-        module, so quant/float and sp/non-sp programs can never collide in
-        jit/AOT caches. sp composes with quant: the sp clone starts from the
-        quant clone."""
+        """The model variant a config's programs trace: ``quant``, ``fused``,
+        the sp mesh, and the sp axis names are all fields of the
+        (hash-by-value) module, so quant/float, fused/unfused and sp/non-sp
+        programs can never collide in jit/AOT caches. sp composes with quant
+        and fused: the sp clone starts from the quant/fused clone (under sp
+        the fused attention falls back in-model, but the fused Mlp still
+        applies)."""
         base = self.model
-        if config.quant:
-            base = self._quant_models.get(config.quant)
+        if config.quant or config.fused:
+            key = (config.quant, config.fused)
+            base = self._quant_models.get(key)
             if base is None:
-                base = self._quant_models[config.quant] = self.model.clone(
-                    quant=config.quant)
+                base = self._quant_models[key] = self.model.clone(
+                    quant=config.quant, fused=config.fused)
         if config.sp_degree == 1:
             return base
-        key = (config.sp_mode, config.sp_degree, config.quant)
+        key = (config.sp_mode, config.sp_degree, config.quant, config.fused)
         model = self._sp_models.get(key)
         if model is None:
             from ddim_cold_tpu.models.vit import sp_clone

@@ -270,6 +270,19 @@ def render(path: str) -> str:
                 + (" · ".join(f"{k}={v}" for k, v in sorted(st.items()))
                    or "no checks"))
 
+    fu = sub.get("fusion")
+    if fu:
+        uf, fd = fu.get("unfused", {}), fu.get("fused", {})
+        lines.append("")
+        lines.append(
+            f"**fused trunk (k={fu.get('k')}, buckets={fu.get('buckets')}):** "
+            f"{uf.get('per_step_ms')} ms/step unfused → "
+            f"{fd.get('per_step_ms')} ms fused ({fu.get('speedup')}×) · "
+            f"{fd.get('img_per_sec')} img/s · MFU {uf.get('mfu')} → "
+            f"{fd.get('mfu')} · oracle {fu.get('oracle')} (max |Δ| "
+            f"{fu.get('max_abs_pixel_delta')}) · compiles after warmup "
+            f"{fu.get('compiles_after_warmup')}")
+
     pl = sub.get("parallel")
     if pl and not pl.get("skipped"):
         degs = pl.get("degrees", {})

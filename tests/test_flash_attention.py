@@ -433,7 +433,7 @@ def test_kernel_gemms_run_in_input_dtype_with_f32_accumulation(dtype):
     inheriting a stale guard."""
     from ddim_cold_tpu.ops import flash_attention as fa
 
-    assert fa.KERNEL_REV == "bf16-gemm-v2", (
+    assert fa.KERNEL_REV == "fused-trunk-v3", (
         "kernel revision changed — re-derive the GEMM dtype contract here")
 
     dt = jnp.dtype(dtype)
@@ -460,6 +460,96 @@ def test_kernel_gemms_run_in_input_dtype_with_f32_accumulation(dtype):
             assert invar.aval.dtype == dt, (
                 f"kernel GEMM operand traced as {invar.aval.dtype}, "
                 f"expected input dtype {dt}: {eqn}")
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float32"])
+def test_fused_kernel_gemms_run_in_input_dtype(dtype):
+    """fused-trunk-v3 extension of the GEMM dtype guard: every dot inside
+    the fused trunk-attention megakernel (qkv dequant producer, logits,
+    p·v, proj consumer) and the fused Mlp kernel (x·w1, gelu·w2) takes its
+    operands in the ACTIVATION dtype with f32 accumulation — the int8
+    weights are upcast to the activation dtype, never to f32."""
+    from ddim_cold_tpu.ops import quant
+    from ddim_cold_tpu.ops.flash_attention import fused_trunk_attention
+
+    dt = jnp.dtype(dtype)
+    C, H, N = 64, 2, 40
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((1, N, C)), dt)
+    wq = jnp.asarray(rng.integers(-127, 128, (C, 3 * C)), jnp.int8)
+    wp = jnp.asarray(rng.integers(-127, 128, (C, C)), jnp.int8)
+    sq = jnp.ones((3 * C,), jnp.float32)
+    bq = jnp.zeros((3 * C,), jnp.float32)
+    sp = jnp.ones((C,), jnp.float32)
+    bp = jnp.zeros((C,), jnp.float32)
+
+    fwd = jax.make_jaxpr(lambda xx: fused_trunk_attention(
+        xx, wq, sq, bq, wp, sp, bp, num_heads=H, scale=(C // H) ** -0.5,
+        block_q=48, block_kv=48))(x)
+    dots = _kernel_dot_eqns(fwd.jaxpr)
+    # q projection + kv-chunk projection + proj consumer, plus the unrolled
+    # per-head logits and p·v dots
+    assert len(dots) == 3 + 2 * H, dots
+    for eqn in dots:
+        pref = eqn.params.get("preferred_element_type")
+        assert pref is not None and jnp.dtype(pref) == jnp.float32, eqn
+        for invar in eqn.invars:
+            assert invar.aval.dtype == dt, (
+                f"fused kernel GEMM operand traced as {invar.aval.dtype}, "
+                f"expected input dtype {dt}: {eqn}")
+
+    x2 = jnp.asarray(rng.standard_normal((N, C)), dt)
+    w1 = jnp.asarray(rng.integers(-127, 128, (C, C)), jnp.int8)
+    mlp = jax.make_jaxpr(lambda xx: quant.mlp_pallas(
+        xx, w1, bp, w1, bp, scale1=sp, scale2=sp, mode="pallas",
+        block_m=48))(x2)
+    mdots = _kernel_dot_eqns(mlp.jaxpr)
+    assert len(mdots) == 2, mdots  # x·w1, gelu(h)·w2
+    for eqn in mdots:
+        pref = eqn.params.get("preferred_element_type")
+        assert pref is not None and jnp.dtype(pref) == jnp.float32, eqn
+        for invar in eqn.invars:
+            assert invar.aval.dtype == dt, eqn
+
+
+def test_fused_kernel_w8a8_gemms_hit_int8_path():
+    """w8a8: the two weight-side GEMMs in each fused kernel run int8×int8
+    with int32 accumulation (requantized activations); the attention's
+    logits/p·v dots stay in the f32 compute dtype."""
+    from ddim_cold_tpu.ops import quant
+    from ddim_cold_tpu.ops.flash_attention import fused_trunk_attention
+
+    C, H, N = 64, 2, 40
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((1, N, C)), jnp.float32)
+    wq = jnp.asarray(rng.integers(-127, 128, (C, 3 * C)), jnp.int8)
+    wp = jnp.asarray(rng.integers(-127, 128, (C, C)), jnp.int8)
+    sq = jnp.ones((3 * C,), jnp.float32)
+    bq = jnp.zeros((3 * C,), jnp.float32)
+    sp = jnp.ones((C,), jnp.float32)
+    bp = jnp.zeros((C,), jnp.float32)
+
+    fwd = jax.make_jaxpr(lambda xx: fused_trunk_attention(
+        xx, wq, sq, bq, wp, sp, bp, num_heads=H, scale=(C // H) ** -0.5,
+        block_q=48, block_kv=48, mode="w8a8"))(x)
+    dots = _kernel_dot_eqns(fwd.jaxpr)
+    assert len(dots) == 3 + 2 * H, dots
+    int8_dots = [e for e in dots
+                 if all(v.aval.dtype == jnp.int8 for v in e.invars)]
+    assert len(int8_dots) == 3, dots  # q + kv producers, proj consumer
+    for eqn in int8_dots:
+        assert jnp.dtype(eqn.params["preferred_element_type"]) == jnp.int32
+
+    x2 = jnp.asarray(rng.standard_normal((N, C)), jnp.float32)
+    w1 = jnp.asarray(rng.integers(-127, 128, (C, C)), jnp.int8)
+    mlp = jax.make_jaxpr(lambda xx: quant.mlp_pallas(
+        xx, w1, bp, w1, bp, scale1=sp, scale2=sp, mode="w8a8",
+        block_m=48))(x2)
+    mdots = _kernel_dot_eqns(mlp.jaxpr)
+    assert len(mdots) == 2, mdots
+    for eqn in mdots:
+        assert all(v.aval.dtype == jnp.int8 for v in eqn.invars), eqn
+        assert jnp.dtype(eqn.params["preferred_element_type"]) == jnp.int32
 
 
 from ddim_cold_tpu.ops.flash_attention import blockwise_attention_xla  # noqa: E402

@@ -161,11 +161,14 @@ def test_clean_in_tree_memory(kernel_traces):
     peaks = {name: memory_checks.peak_live_bytes(c)
              for name, (e, c) in kernel_traces.items()
              if (e.meta or {}).get("memory")}
-    assert set(peaks) == {"ns200_f32", "ns200_bf16", "ns200_w8a16"}
+    assert set(peaks) == {"ns200_f32", "ns200_bf16", "ns200_w8a16",
+                          "ns200_w8a16_fused", "ns200_w8a8_fused"}
     for name, peak in peaks.items():
         assert 10 * 2**20 < peak < 2**31, (name, peak)
     # quantized weights must not peak above the f32 build
     assert peaks["ns200_w8a16"] < peaks["ns200_f32"]
+    # fusing deletes intermediates; it must not grow the liveness peak
+    assert peaks["ns200_w8a16_fused"] <= peaks["ns200_w8a16"] * 1.05
 
 
 def test_budget_report_rollups(kernel_traces):
@@ -176,5 +179,6 @@ def test_budget_report_rollups(kernel_traces):
     assert 0 < report["peak_hbm_gb"] <= report["hbm_budget_gib"]
     assert 0 < report["max_kernel_vmem_mb"] <= report["vmem_budget_mib"]
     assert set(report["programs"]) == {"ns200_f32", "ns200_bf16",
-                                       "ns200_w8a16"}
+                                       "ns200_w8a16", "ns200_w8a16_fused",
+                                       "ns200_w8a8_fused"}
     assert len(report["kernels"]) >= 10
