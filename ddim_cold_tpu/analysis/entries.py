@@ -104,6 +104,19 @@ def serve_sweep():
          (4,)),
         ("interp_k500_t400",
          SamplerConfig(task="interp", k=K, t_start=400), (4,)),
+        # few-step distilled family (ISSUE 17): scan over steps-1 schedule
+        # updates + the final jump-to-clean forward OUTSIDE the scan, so
+        # steps=1 lowers scan-free and every k is structurally distinct
+        # from the stride family's equal-trip-count scans. NO student
+        # variants here: a student config runs the teacher's program on
+        # different params (warmup dedup relies on exactly that), so a
+        # student entry would be a deliberate J006 collision.
+        ("ddim_fs1", SamplerConfig(steps=1), (4, 8)),
+        ("ddim_fs2", SamplerConfig(steps=2), (4, 8)),
+        ("ddim_fs4", SamplerConfig(steps=4), (4,)),
+        ("ddim_fs4_ci2", SamplerConfig(steps=4, cache_interval=2), (4,)),
+        ("ddim_fs2_pv1", SamplerConfig(steps=2, preview_every=1), (4,)),
+        ("ddim_fs1_qxla", SamplerConfig(steps=1, quant="xla"), (4,)),
     ]
     # sequence-parallel program family (sp_mode/sp_degree — the engine's
     # (data, seq)-mesh executables). Gated on the PROCESS's device count:
@@ -391,9 +404,23 @@ def _serve_entry(ctx: Context, config, bucket: int) -> Entry:
         fn = sampling._cold_scan_seq if seq else sampling._cold_scan
         return Entry("serve", "", fn, (params, x), (model,),
                      dict(levels=config.levels, return_sequence=seq))
+    if config.steps > 0:
+        if config.cached:
+            fn = (sampling._ddim_scan_fewstep_cached_seq if seq
+                  else sampling._ddim_scan_fewstep_cached)
+            return Entry("serve", "", fn,
+                         (params, x, ctx.key,
+                          ctx.cache(bucket, config.cache_mode)), (model,),
+                         dict(steps=config.steps, t_start=config.t_start,
+                              eta=0.0, sequence=seq, **cache_kw))
+        fn = (sampling._ddim_scan_fewstep_seq if seq
+              else sampling._ddim_scan_fewstep)
+        return Entry("serve", "", fn, (params, x, ctx.key), (model,),
+                     dict(steps=config.steps, t_start=config.t_start,
+                          eta=0.0, sequence=seq))
     if config.cached:
         if config.telemetry:
-            # mirrors Engine._ddim_cached_tel_lower: the telemetry scan has
+            # mirrors Engine._ddim_cached_tel_spec: the telemetry scan has
             # no `sequence` static (last-only by contract)
             return Entry("serve", "", sampling._ddim_scan_cached_tel,
                          (params, x, ctx.key,
@@ -501,6 +528,16 @@ def kernel_entries() -> list[Entry]:
             (params, xr, key), (model,),
             dict(k=NS_K, t_start=None, eta=0.0), donates=True,
             meta=dict(mem)))
+
+    # few-step distilled serving at the north star (ISSUE 17): the k=4
+    # student program the --fewstep bench leg dispatches — 3-trip schedule
+    # scan + the final jump-to-clean forward — so the P-rules certify its
+    # pallas calls and the M-rules its peak-HBM at the 200px geometry
+    entries.append(Entry(
+        "ns200_fewstep4_bf16", _FLASH_PATH, sampling._ddim_scan_fewstep,
+        (fparams, xr, key), (base,),
+        dict(steps=4, t_start=None, eta=0.0, sequence=False), donates=True,
+        meta=dict(mem)))
 
     # standalone flash kernels per (dtype, blocks): forward for every
     # sweep row, grad (the backward dq/dkv kernels) at the default and

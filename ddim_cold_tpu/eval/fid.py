@@ -386,6 +386,75 @@ def quantized_sampler_guard(
     }
 
 
+def distilled_sampler_guard(
+    model,
+    teacher_params,
+    student_params,
+    *,
+    rng: jax.Array,
+    steps: int,
+    n_samples: int = 256,
+    sample_batch: int = 64,
+    k: int = 20,
+    cache_interval: int = 1,
+    cache_mode: str = "full",
+    inception_model=None,
+    inception_variables=None,
+) -> dict:
+    """Quality guard for few-step distilled serving (train/distill.py +
+    ``SamplerConfig(steps=k)``), the exact shape of
+    :func:`quantized_sampler_guard`: the Fréchet distance between the
+    TEACHER's k-step baseline stream and the STUDENT's ``steps``-evaluation
+    stream from the SAME rng sequence under one extractor — so a latency win
+    bought by cutting k can never silently buy a quality loss. Run it once
+    per served student (steps ∈ {1, 2, 4}) to fill PERF.md's k-vs-quality
+    table.
+
+    Both streams draw the SAME init per batch (same sub-key, same n), so
+    the distance isolates the schedule compression: teacher refines that
+    init over ``k`` strided steps (``ddim_sample``), the student jumps it
+    through its ``steps``-level schedule (``ddim_sample_fewstep``).
+    ``cache_interval`` > 1 routes the STUDENT stream through the step cache,
+    measuring the composed shift (distillation × block reuse). Unlike the
+    quant guard there is no ``max_abs_pixel_delta`` acceptance reading —
+    teacher and student outputs differ by design; the Fréchet shift IS the
+    metric.
+    """
+    from ddim_cold_tpu.ops import sampling
+
+    feature_fn, dim = make_feature_fn(inception_model, inception_variables)
+    teacher, student = ActivationStats(dim), ActivationStats(dim)
+    max_delta = 0.0
+    remaining = n_samples
+    while remaining > 0:
+        keep = min(sample_batch, remaining)
+        rng, sub = jax.random.split(rng)
+        imgs_t = sampling.ddim_sample(model, teacher_params, sub, k=k,
+                                      n=sample_batch)
+        imgs_s = sampling.ddim_sample_fewstep(model, student_params, sub,
+                                              steps=steps, n=sample_batch,
+                                              cache_interval=cache_interval,
+                                              cache_mode=cache_mode)
+        max_delta = max(max_delta, float(jnp.max(jnp.abs(imgs_t - imgs_s))))
+        teacher.update(np.asarray(feature_fn(imgs_t))[:keep])
+        student.update(np.asarray(feature_fn(imgs_s))[:keep])
+        remaining -= keep
+    return {
+        "fid_teacher_vs_student": round(float(fid_from_stats(teacher,
+                                                             student)), 4),
+        "max_abs_pixel_delta": round(max_delta, 6),
+        "n_samples": n_samples,
+        "k": k,
+        "steps": steps,
+        "cache_interval": cache_interval,
+        "cache_mode": cache_mode,
+        "extractor": ("canonical" if inception_variables is not None else
+                      "seeded random-init proxy (paired streams, same "
+                      "extractor — distance is meaningful, absolute FID "
+                      "scale is not)"),
+    }
+
+
 def superres_consistency_guard(outputs, low_res) -> dict:
     """Editing-quality guard for served super-resolution (ROADMAP open
     item): the delivered output must still CONTAIN its input — nearest-

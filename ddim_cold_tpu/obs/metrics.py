@@ -38,6 +38,8 @@ from typing import Optional
 METRICS = (
     # -- engine (one scope per Engine instance) ---------------------------
     ("engine.compiles", "counter", "XLA programs built (ensure_program)"),
+    ("engine.program_aliases", "counter",
+     "program keys aliased to an equal-fingerprint executable (warmup dedup)"),
     ("engine.dispatches", "counter", "batches dispatched to the device"),
     ("engine.rows", "counter", "request rows served"),
     ("engine.padded_rows", "counter", "pad rows shipped for bucket alignment"),
@@ -61,6 +63,8 @@ METRICS = (
      "device-telemetry: adaptive-gate reuse steps observed"),
     # -- warmup (emitted under the warmed engine's scope) -----------------
     ("warmup.new_compiles", "counter", "programs compiled during warmup"),
+    ("warmup.deduped", "counter",
+     "warmup keys served by aliasing instead of compiling"),
     ("warmup.programs", "gauge", "resident programs after warmup"),
     # -- router -----------------------------------------------------------
     ("router.submitted", "counter", "fleet requests admitted"),

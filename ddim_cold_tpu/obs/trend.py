@@ -69,6 +69,11 @@ BENCH_CHECKS = (
     # its advantage over the unfused w8a16 composition must not decay
     ("submetrics.fusion.fused.img_per_sec", "higher"),
     ("submetrics.fusion.speedup", "higher"),
+    # few-step distilled-sampling leg (bench --fewstep): the served per-k
+    # throughput at both ends of the {1, 2, 4} family must not decay (the
+    # latency contract itself is enforced in-leg — the bench raises)
+    ("submetrics.fewstep.per_k.1.img_per_sec", "higher"),
+    ("submetrics.fewstep.per_k.4.img_per_sec", "higher"),
 )
 MULTICHIP_CHECKS = (
     ("rc", "zero"),

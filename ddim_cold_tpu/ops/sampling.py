@@ -110,6 +110,180 @@ def _ddim_scan_last(model, params, x_init, noise_rng, *, k: int,
     return (x0_last + 1.0) / 2.0
 
 
+def _fewstep_impl(model, params, x_init, noise_rng, *, steps: int,
+                  t_start: Optional[int], eta: float, sequence: bool):
+    """The few-step (distilled-student) scan family: ``steps`` model
+    evaluations along the proportional ``fewstep_time_sequence``, with the
+    FINAL evaluation hoisted OUT of the scan. The hoist is licensed by the
+    schedule algebra (schedule.fewstep_coefficients): the last jump targets
+    the clean image (ᾱ = 1), where the affine update degenerates to
+    x' = x̂₀ exactly — so the program is scan(steps−1 updates) + one bare
+    forward, and ``steps=1`` compiles to a scan-free single forward. That
+    structure is also what keeps every k∈{1,2,4} program STRUCTURALLY
+    distinct from the stride family's equal-trip-count scans under
+    graftcheck's constant-blind J006 signature (a k-strided scan of equal
+    length would hash identically once the baked coefficients are ignored).
+    """
+    coeffs = schedule.fewstep_coefficients(model.total_steps, steps, t_start,
+                                           eta)
+    n = x_init.shape[0]
+
+    def forward(x, t):
+        with profiling.scope("sampler/model"):
+            x0 = model.apply({"params": params}, x,
+                             jnp.full((n,), t, jnp.int32))
+        return jnp.clip(x0, -1.0, 1.0)
+
+    x, x0_out = x_init, None
+    if steps > 1:
+        def step(x, inputs):
+            t, c1, c2, cz = inputs
+            x0 = forward(x, t)
+            return (_ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta),
+                    x0 if sequence else None)
+
+        x, x0_out = jax.lax.scan(
+            step, x_init, tuple(a[:-1] for a in _scan_inputs(coeffs)))
+    x0_last = forward(x, int(coeffs.t_seq[-1]))
+    if sequence:
+        frames = [x_init[None]] + ([x0_out] if x0_out is not None else []) \
+            + [x0_last[None]]
+        return (jnp.concatenate(frames, axis=0) + 1.0) / 2.0
+    return (x0_last + 1.0) / 2.0
+
+
+_FEWSTEP_STATICS = ("model", "steps", "t_start", "eta", "sequence")
+#: last-only entry donates x_init (image output aliases it), mirroring the
+#: stride family; the sequence entry never donates.
+_ddim_scan_fewstep = jax.jit(_fewstep_impl, static_argnames=_FEWSTEP_STATICS,
+                             donate_argnames=("x_init",))
+_ddim_scan_fewstep_seq = jax.jit(_fewstep_impl,
+                                 static_argnames=_FEWSTEP_STATICS)
+
+
+def _fewstep_cached_impl(model, params, x_init, noise_rng, cache0, *,
+                         steps: int, t_start: Optional[int], eta: float,
+                         cache_interval: int, cache_mode: str,
+                         cache_threshold=None, cache_tokens=None,
+                         sequence: bool):
+    """Few-step scan composed with the step cache (ops/step_cache.py): the
+    first steps−1 evaluations route through ``apply_step`` inside the scan,
+    and the hoisted final evaluation takes the schedule's LAST branch id
+    outside it — the same refresh/reuse pattern a ``steps``-long cached
+    stride scan would run, so the composition semantics (and the τ→0 /
+    k_tok→all bitwise degeneracies) carry over unchanged. Returns
+    ``(images, final_cache)`` for the engine's cache recycling."""
+    coeffs = schedule.fewstep_coefficients(model.total_steps, steps, t_start,
+                                           eta)
+    spec = _cached_spec(model, steps, cache_interval, cache_mode,
+                        cache_threshold, cache_tokens)
+    n = x_init.shape[0]
+    branches = jnp.asarray(spec.branches, jnp.int32)
+
+    def evaluate(x, t, br, cache):
+        with profiling.scope("sampler/cached_step"):
+            x0_raw, cache = step_cache.apply_step(
+                model, params, x, jnp.full((n,), t, jnp.int32), br, cache,
+                spec)
+        return jnp.clip(x0_raw, -1.0, 1.0), cache
+
+    x, cache, x0_out = x_init, cache0, None
+    if steps > 1:
+        def step(carry, inputs):
+            x, cache = carry
+            (t, c1, c2, cz), br = inputs
+            x0, cache = evaluate(x, t, br, cache)
+            x_next = _ddim_step_update(x, x0, t, c1, c2, cz, noise_rng, eta)
+            return (x_next, cache), (x0 if sequence else None)
+
+        (x, cache), x0_out = jax.lax.scan(
+            step, (x_init, cache0),
+            (tuple(a[:-1] for a in _scan_inputs(coeffs)), branches[:-1]))
+    x0_last, cache_out = evaluate(x, int(coeffs.t_seq[-1]), branches[-1],
+                                  cache)
+    if sequence:
+        frames = [x_init[None]] + ([x0_out] if x0_out is not None else []) \
+            + [x0_last[None]]
+        return (jnp.concatenate(frames, axis=0) + 1.0) / 2.0, cache_out
+    return (x0_last + 1.0) / 2.0, cache_out
+
+
+_FEWSTEP_CACHED_STATICS = ("model", "steps", "t_start", "eta",
+                           "cache_interval", "cache_mode", "cache_threshold",
+                           "cache_tokens", "sequence")
+#: donation mirrors the cached stride scan: x_init and the cache carry alias
+#: outputs on the last-only entry; the sequence entry never donates.
+_ddim_scan_fewstep_cached = jax.jit(
+    _fewstep_cached_impl, static_argnames=_FEWSTEP_CACHED_STATICS,
+    donate_argnames=("x_init", "cache0"))
+_ddim_scan_fewstep_cached_seq = jax.jit(
+    _fewstep_cached_impl, static_argnames=_FEWSTEP_CACHED_STATICS)
+
+
+def ddim_sample_fewstep(
+    model,
+    params,
+    rng: Optional[jax.Array] = None,
+    *,
+    steps: int,
+    n: int = 128,
+    x_init: Optional[jax.Array] = None,
+    t_start: Optional[int] = None,
+    return_sequence: bool = False,
+    mesh=None,
+    eta: float = 0.0,
+    cache_interval: int = 1,
+    cache_mode: str = "delta",
+    cache_threshold: Optional[float] = None,
+    cache_tokens: Optional[int] = None,
+) -> jax.Array:
+    """Few-step DDIM sampling: exactly ``steps`` model evaluations (the
+    distilled-student serving path, k∈{1,2,4}); returns images in [0, 1].
+
+    Where :func:`ddim_sample` fixes a STRIDE k (the step count falls out of
+    T), this fixes the step COUNT along the proportional
+    ``schedule.fewstep_time_sequence`` — one compiled program per ``steps``
+    regardless of T, which is what ``SamplerConfig(steps=...)`` serves.
+    Running a k=20-trained teacher through ``steps`` ≤ 4 is a (poor-quality)
+    valid program — the intended params are a ``train/distill.py`` student,
+    but nothing here checks provenance; ``eval/fid.py
+    distilled_sampler_guard`` is the quality gate.
+
+    ``rng``/``x_init``/``t_start``/``return_sequence``/``mesh``/``eta`` and
+    the ``cache_*`` statics behave exactly as in :func:`ddim_sample`
+    (guided private copy, data-axis SPMD, stochastic eta, step-cache
+    composition).
+    """
+    if eta and rng is None:
+        raise ValueError("eta > 0 draws per-step noise — pass rng")
+    if x_init is None:
+        if rng is None:
+            raise ValueError("ddim_sample_fewstep needs either rng or x_init")
+        H, W = model.img_size
+        x_init = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    elif mesh is None and not return_sequence:
+        # last-only scans donate x_init — guided starts enter via a private
+        # copy, exactly like ddim_sample's guided path
+        x_init = jnp.array(x_init, copy=True)
+    x_init = _shard_init(x_init, mesh)
+    noise_rng = (jax.random.fold_in(rng, 0xD1F) if rng is not None
+                 else jax.random.PRNGKey(0))
+    if step_cache.enabled(cache_interval):
+        fn = (_ddim_scan_fewstep_cached_seq if return_sequence
+              else _ddim_scan_fewstep_cached)
+        out, _ = fn(
+            model, params, x_init, noise_rng,
+            _make_cache(model, x_init, mesh, cache_mode),
+            steps=steps, t_start=t_start, eta=eta,
+            cache_interval=cache_interval, cache_mode=cache_mode,
+            cache_threshold=cache_threshold, cache_tokens=cache_tokens,
+            sequence=return_sequence)
+        return out
+    fn = _ddim_scan_fewstep_seq if return_sequence else _ddim_scan_fewstep
+    return fn(model, params, x_init, noise_rng, steps=steps, t_start=t_start,
+              eta=eta, sequence=return_sequence)
+
+
 def _cached_spec(model, n_steps: int, cache_interval: int, cache_mode: str,
                  cache_threshold, cache_tokens) -> step_cache.CacheSpec:
     """One spec-construction site for every cached scan: supplies the

@@ -91,6 +91,20 @@ class SamplerConfig:
     # the engine decodes it into ``Ticket.telemetry`` (obs/device.py).
     # Static: selects a distinct compiled program (one extra warmup entry);
     # images stay bitwise identical with telemetry on or off.
+    steps: int = 0                 # 0 = the k-STRIDED family above (the
+    # pre-fewstep default — every existing config stays hash-equal to its
+    # old self); >= 1 selects the few-step family
+    # (ops/sampling.ddim_sample_fewstep): exactly ``steps`` model
+    # evaluations along the proportional schedule, the distilled-student
+    # serving path (k∈{1,2,4}). ``k`` is ignored when steps > 0; ``t_start``
+    # still sets the schedule's start level. Static: part of the program
+    # key — fewstep and stride requests never coalesce.
+    student: bool = False          # route this config's dispatches through
+    # the engine's distilled-student param tree (Engine(student_params=...))
+    # instead of the teacher's. Purely a PARAM selection — the compiled
+    # program is identical to the teacher's at the same steps (warmup dedup
+    # exploits exactly that) — but student and teacher requests must never
+    # share a batch, so it is part of the config (and the cache key).
 
     def __post_init__(self):
         if self.sampler not in _SAMPLERS:
@@ -186,6 +200,29 @@ class SamplerConfig:
                 "take DIFFERENT refresh branches and desynchronize the "
                 "carry — use cache_mode='delta'/'full'/'token' with sp, or "
                 "sp_degree=1 for adaptive caching")
+        if self.steps < 0:
+            raise ValueError(
+                f"steps must be >= 0 (0 = the k-strided family, >= 1 = the "
+                f"few-step family), got {self.steps}")
+        if self.student and self.steps < 1:
+            raise ValueError(
+                "student=True serves a few-step distilled student — pass "
+                "steps=<its evaluation count, e.g. 1/2/4> (student params "
+                "under the stride family would silently mis-serve a "
+                "teacher-schedule request)")
+        if self.steps > 0:
+            if self.sampler != "ddim":
+                raise ValueError(
+                    "steps > 0 is the few-step DDIM family — "
+                    f"got sampler={self.sampler!r}")
+            if self.task != "sample":
+                raise ValueError(
+                    "steps > 0 serves plain generation only — task "
+                    f"{self.task!r} has no few-step scan variant yet")
+            if self.telemetry:
+                raise ValueError(
+                    "telemetry decodes the CACHED STRIDE scan's step aux — "
+                    "it has no few-step variant; drop telemetry or steps")
         if self.telemetry:
             if self.sampler != "ddim" or not self.cached:
                 raise ValueError(

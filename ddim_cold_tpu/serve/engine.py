@@ -146,6 +146,7 @@ class Engine:
 
     def __init__(self, model, params, mesh=None,
                  buckets: Sequence[int] = (8, 32, 128), *,
+                 student_params=None,
                  prefetch_depth: int = 2, inflight: int = 2,
                  max_queue: Optional[int] = None,
                  max_retries: int = 2, retry_base_s: float = 0.05,
@@ -170,6 +171,14 @@ class Engine:
                 f"buckets {bad} do not divide the mesh data axis ({shards}); "
                 "sharded placement needs even divisibility")
         self.params = shard_params(params, mesh) if mesh is not None else params
+        # distilled few-step student (train/distill.py): same architecture,
+        # different weights — shipped/pinned exactly like the teacher tree.
+        # config.student routes _params_for here; the PROGRAM is shared with
+        # the teacher at equal steps (params are a runtime argument), which
+        # is what lets warmup dedup alias student configs for free.
+        self.student_params = (shard_params(student_params, mesh)
+                               if mesh is not None and student_params
+                               is not None else student_params)
         self.prefetch_depth = int(prefetch_depth)
         self.inflight = max(1, int(inflight))
         if max_queue is not None and max_queue < 1:
@@ -197,12 +206,13 @@ class Engine:
         # — an sp program must see params on ITS mesh, not the engine's)
         self._sp_meshes: dict = {}   # sp_degree -> Mesh
         self._sp_models: dict = {}   # (mode, degree, quant) -> model clone
-        self._sp_params: dict = {}   # (degree, quantized?) -> placed tree
+        self._sp_params: dict = {}   # (degree, quantized?, student?) -> tree
         # w8a16 serving (ops/quant.py): the int8 tree is built ONCE from the
         # float params on the first quant config and shipped/pinned like the
         # float tree — every quant dispatch reuses the same device buffers
         # (≈4× fewer trunk-param bytes over the link than the float tree).
         self._qparams = None
+        self._qparams_student = None
         self._quant_models: dict = {}  # (quant, fused) -> model clone
         self._pending: list[Request] = []               # guarded-by: _lock
         # rid -> unresolved Request (stall fail set)
@@ -238,6 +248,7 @@ class Engine:
         m = self.metrics
         return {
             "compiles": m.value("engine.compiles"),
+            "program_aliases": m.value("engine.program_aliases"),
             "dispatches": m.value("engine.dispatches"),
             "rows": m.value("engine.rows"),
             "padded_rows": m.value("engine.padded_rows"),
@@ -494,26 +505,40 @@ class Engine:
         return model
 
     def _params_for(self, config: SamplerConfig):
-        if not config.quant:
-            base = self.params
+        if config.student:
+            if self.student_params is None:
+                raise ValueError(
+                    "config.student=True but this engine holds no student "
+                    "tree — pass student_params= at construction (the "
+                    "distilled checkpoint from train/distill.py)")
+            float_tree = self.student_params
         else:
-            if self._qparams is None:
+            float_tree = self.params
+        if not config.quant:
+            base = float_tree
+        else:
+            # one int8 tree per weight set (teacher / student), built lazily
+            # on the first quant config that needs it and pinned for reuse
+            attr = "_qparams_student" if config.student else "_qparams"
+            base = getattr(self, attr)
+            if base is None:
                 from ddim_cold_tpu.ops import quant
 
-                qp = quant.quantize_params(self.params)
-                self._qparams = (shard_params(qp, self.mesh)
-                                 if self.mesh is not None else qp)
-                self.metrics.gauge("engine.param_bytes",
-                                   quant.param_bytes(self.params))
-                self.metrics.gauge("engine.param_bytes_quant",
-                                   quant.param_bytes(self._qparams))
-            base = self._qparams
+                qp = quant.quantize_params(float_tree)
+                base = (shard_params(qp, self.mesh)
+                        if self.mesh is not None else qp)
+                setattr(self, attr, base)
+                if not config.student:
+                    self.metrics.gauge("engine.param_bytes",
+                                       quant.param_bytes(float_tree))
+                    self.metrics.gauge("engine.param_bytes_quant",
+                                       quant.param_bytes(base))
         if config.sp_degree == 1:
             return base
         # re-place (replicated) on the config's (data, seq) mesh, once per
-        # (degree, quantization) — the sp executable rejects params committed
-        # to a different mesh
-        key = (config.sp_degree, bool(config.quant))
+        # (degree, quantization, weight set) — the sp executable rejects
+        # params committed to a different mesh
+        key = (config.sp_degree, bool(config.quant), bool(config.student))
         placed = self._sp_params.get(key)
         if placed is None:
             placed = self._sp_params[key] = shard_params(
@@ -545,10 +570,12 @@ class Engine:
         return jax.ShapeDtypeStruct((bucket, H, W, 1), jnp.float32,
                                     sharding=self._sharding_for(config))
 
-    def _build_program(self, config: SamplerConfig, bucket: int):
-        """AOT-compile the scan for this (config, bucket): trace with shape
-        structs (no dummy allocation), compile, return the executable. The
-        executable is called with the NON-static args only (params, x, …).
+    def _program_spec(self, config: SamplerConfig, bucket: int):
+        """The ``(jitted scan, positional args, static kwargs)`` triple this
+        (config, bucket) lowers — the single source of program identity.
+        :meth:`_build_program` compiles the triple; :meth:`program_fingerprint`
+        traces the SAME triple to a jaxpr for warmup dedup, so the two can
+        never disagree about what a key would compile.
 
         ``preview_every > 0`` selects the sequence-returning variant of the
         SAME scan — trajectory frames are the preview stream and the final
@@ -557,44 +584,93 @@ class Engine:
         compiles at serve time. ``task`` picks the scan family: inpaint has
         its own constrained scan; the other tasks reuse the plain programs
         (their task-ness lives entirely in the init, so e.g. draft and
-        guided-sample configs with equal fields share an executable)."""
+        guided-sample configs with equal fields share an executable).
+        ``steps > 0`` picks the few-step family (ops/sampling.py): one scan
+        over the explicit step-index schedule per k, the final jump-to-clean
+        update outside the scan — so k=1 lowers scan-free."""
         x = self._x_struct(bucket, config)
         model, params = self._model_for(config), self._params_for(config)
         seq = config.preview_every > 0
         if config.task == "inpaint":
             if config.cached:
-                return _inpaint_cached_lower(
+                return _inpaint_cached_spec(
                     model, params, x, self._mask_struct(bucket, config),
                     self._key0, self._cache_struct(bucket, config), config,
                     seq)
             fn = (sampling._ddim_scan_inpaint_seq if seq
                   else sampling._ddim_scan_inpaint)
-            return fn.lower(
-                model, params, x, x, self._mask_struct(bucket, config),
-                self._key0,
-                k=config.k, t_start=config.t_start, eta=0.0,
-                sequence=seq).compile()
+            return fn, (model, params, x, x,
+                        self._mask_struct(bucket, config), self._key0), dict(
+                k=config.k, t_start=config.t_start, eta=0.0, sequence=seq)
         if config.sampler == "cold":
             if config.cached:
-                return _cold_cached_lower(model, params, x,
-                                          self._cache_struct(bucket, config),
-                                          config, seq)
+                return _cold_cached_spec(model, params, x,
+                                         self._cache_struct(bucket, config),
+                                         config, seq)
             fn = sampling._cold_scan_seq if seq else sampling._cold_scan
-            return fn.lower(
-                model, params, x, levels=config.levels,
-                return_sequence=seq).compile()
+            return fn, (model, params, x), dict(levels=config.levels,
+                                                return_sequence=seq)
+        if config.steps > 0:
+            if config.cached:
+                return _fewstep_cached_spec(
+                    model, params, x, self._key0,
+                    self._cache_struct(bucket, config), config, seq)
+            fn = (sampling._ddim_scan_fewstep_seq if seq
+                  else sampling._ddim_scan_fewstep)
+            return fn, (model, params, x, self._key0), dict(
+                steps=config.steps, t_start=config.t_start, eta=0.0,
+                sequence=seq)
         if config.cached:
             if config.telemetry:
-                return _ddim_cached_tel_lower(
+                return _ddim_cached_tel_spec(
                     model, params, x, self._key0,
                     self._cache_struct(bucket, config), config)
-            return _ddim_cached_lower(model, params, x, self._key0,
-                                      self._cache_struct(bucket, config),
-                                      config, seq)
+            return _ddim_cached_spec(model, params, x, self._key0,
+                                     self._cache_struct(bucket, config),
+                                     config, seq)
         fn = sampling._ddim_scan_sequence if seq else sampling._ddim_scan_last
-        return fn.lower(
-            model, params, x, self._key0, k=config.k,
-            t_start=config.t_start, eta=0.0).compile()
+        return fn, (model, params, x, self._key0), dict(
+            k=config.k, t_start=config.t_start, eta=0.0)
+
+    def _build_program(self, config: SamplerConfig, bucket: int):
+        """AOT-compile the scan for this (config, bucket): trace with shape
+        structs (no dummy allocation), compile, return the executable. The
+        executable is called with the NON-static args only (params, x, …)."""
+        fn, args, kwargs = self._program_spec(config, bucket)
+        return fn.lower(*args, **kwargs).compile()
+
+    def program_fingerprint(self, config: SamplerConfig, bucket: int):
+        """Trace-only program identity: the constant-blind ``signature_hash``
+        over the traced jaxpr + input avals, paired with a digest of every
+        captured constant's bytes. Two (config, bucket) keys with equal
+        fingerprints lower the SAME program — warmup dedups on this instead
+        of compiling both (tracing costs milliseconds; XLA costs seconds).
+        The consts digest is load-bearing: ``signature_hash`` is constant-
+        blind by design (J006 uses that), but two configs whose scans bake
+        different coefficient tables must NOT alias."""
+        import hashlib
+
+        from ddim_cold_tpu.analysis.jaxpr_checks import (iter_consts,
+                                                         signature_hash)
+
+        fn, args, kwargs = self._program_spec(config, bucket)
+        traced = fn.trace(*args, **kwargs)
+        sig = signature_hash(traced.jaxpr, traced.in_avals)
+        h = hashlib.sha256()
+        for c in iter_consts(traced.jaxpr):
+            a = np.asarray(c)
+            h.update(f"{a.dtype}{a.shape}".encode())
+            h.update(a.tobytes())
+        return sig, h.hexdigest()
+
+    def adopt_program(self, config: SamplerConfig, bucket: int,
+                      src_key) -> None:
+        """Alias an already-compiled executable under a second (config,
+        bucket) key — warmup's dedup path, valid only when both keys'
+        :meth:`program_fingerprint` match. Does not bump ``compiles``
+        (nothing compiled); counted under ``engine.program_aliases``."""
+        self._programs[(config, bucket)] = self._programs[src_key]
+        self.metrics.inc("engine.program_aliases")
 
     # ------------------------------------------------------------- assembly
 
@@ -1189,49 +1265,60 @@ class Engine:
         return live
 
 
-def _ddim_cached_lower(model, params, x, key, cache, config: SamplerConfig,
-                       seq: bool = False):
+def _ddim_cached_spec(model, params, x, key, cache, config: SamplerConfig,
+                      seq: bool = False):
     fn = (sampling._ddim_scan_cached_seq if seq
           else sampling._ddim_scan_cached)
-    return fn.lower(
-        model, params, x, key, cache, k=config.k, t_start=config.t_start,
+    return fn, (model, params, x, key, cache), dict(
+        k=config.k, t_start=config.t_start,
         eta=0.0, cache_interval=config.cache_interval,
         cache_mode=config.cache_mode,
         cache_threshold=config.cache_threshold,
-        cache_tokens=config.cache_tokens or None, sequence=seq).compile()
+        cache_tokens=config.cache_tokens or None, sequence=seq)
 
 
-def _ddim_cached_tel_lower(model, params, x, key, cache,
-                           config: SamplerConfig):
-    return sampling._ddim_scan_cached_tel.lower(
-        model, params, x, key, cache, k=config.k, t_start=config.t_start,
-        eta=0.0, cache_interval=config.cache_interval,
-        cache_mode=config.cache_mode,
-        cache_threshold=config.cache_threshold,
-        cache_tokens=config.cache_tokens or None).compile()
+def _ddim_cached_tel_spec(model, params, x, key, cache,
+                          config: SamplerConfig):
+    return sampling._ddim_scan_cached_tel, (model, params, x, key, cache), \
+        dict(k=config.k, t_start=config.t_start,
+             eta=0.0, cache_interval=config.cache_interval,
+             cache_mode=config.cache_mode,
+             cache_threshold=config.cache_threshold,
+             cache_tokens=config.cache_tokens or None)
 
 
-def _cold_cached_lower(model, params, x, cache, config: SamplerConfig,
-                       seq: bool = False):
-    fn = (sampling._cold_scan_cached_seq if seq
-          else sampling._cold_scan_cached)
-    return fn.lower(
-        model, params, x, cache, levels=config.levels, return_sequence=seq,
+def _fewstep_cached_spec(model, params, x, key, cache,
+                         config: SamplerConfig, seq: bool = False):
+    fn = (sampling._ddim_scan_fewstep_cached_seq if seq
+          else sampling._ddim_scan_fewstep_cached)
+    return fn, (model, params, x, key, cache), dict(
+        steps=config.steps, t_start=config.t_start, eta=0.0,
         cache_interval=config.cache_interval,
         cache_mode=config.cache_mode,
         cache_threshold=config.cache_threshold,
-        cache_tokens=config.cache_tokens or None).compile()
+        cache_tokens=config.cache_tokens or None, sequence=seq)
 
 
-def _inpaint_cached_lower(model, params, x, mask, key, cache,
-                          config: SamplerConfig, seq: bool = False):
+def _cold_cached_spec(model, params, x, cache, config: SamplerConfig,
+                      seq: bool = False):
+    fn = (sampling._cold_scan_cached_seq if seq
+          else sampling._cold_scan_cached)
+    return fn, (model, params, x, cache), dict(
+        levels=config.levels, return_sequence=seq,
+        cache_interval=config.cache_interval,
+        cache_mode=config.cache_mode,
+        cache_threshold=config.cache_threshold,
+        cache_tokens=config.cache_tokens or None)
+
+
+def _inpaint_cached_spec(model, params, x, mask, key, cache,
+                         config: SamplerConfig, seq: bool = False):
     # known shares x's struct: both are (bucket, H, W, C) f32 batch-sharded
     fn = (sampling._ddim_scan_inpaint_cached_seq if seq
           else sampling._ddim_scan_inpaint_cached)
-    return fn.lower(
-        model, params, x, x, mask, key, cache, k=config.k,
-        t_start=config.t_start, eta=0.0,
+    return fn, (model, params, x, x, mask, key, cache), dict(
+        k=config.k, t_start=config.t_start, eta=0.0,
         cache_interval=config.cache_interval,
         cache_mode=config.cache_mode,
         cache_threshold=config.cache_threshold,
-        cache_tokens=config.cache_tokens or None, sequence=seq).compile()
+        cache_tokens=config.cache_tokens or None, sequence=seq)

@@ -12,6 +12,19 @@ Two layers, matching the two restart costs:
   (utils/platform.enable_compile_cache) is wired first, so the XLA
   executables land on disk and the NEXT process's warmup is a disk read,
   not minutes of XLA. Cache failure is non-fatal (purely an accelerant).
+
+Warmup cost is O(configs × buckets) compiles per replica, but many served
+configs lower to the SAME program — ``preview_every`` values beyond the
+on/off bit never reach the trace, a ``student`` config runs the teacher's
+executable on different params, and the few-step ``k`` field is dead when
+``steps`` is set. Warmup therefore fingerprints each key before compiling
+(``Engine.program_fingerprint`` — trace-only, milliseconds) and ALIASES a
+key whose fingerprint was already compiled this call
+(``Engine.adopt_program``) instead of paying XLA again. The fingerprint
+pairs the constant-blind ``signature_hash`` with a digest of the traced
+constants, so two programs only alias when both the structure and every
+baked coefficient table match byte-for-byte — aliasing can never change
+an output bit.
 """
 
 from __future__ import annotations
@@ -26,6 +39,7 @@ def warmup(engine, configs: Sequence[SamplerConfig],
            buckets: Optional[Sequence[int]] = None, *,
            persistent_cache: bool = True,
            cache_dir: Optional[str] = None,
+           dedup: bool = True,
            tolerate_errors: bool = False) -> dict:
     """Compile every (config, bucket) program the engine may dispatch.
 
@@ -38,6 +52,14 @@ def warmup(engine, configs: Sequence[SamplerConfig],
     the number of new compiles, total resident programs, and the
     persistent-cache directory (None when disabled or the running JAX lacks
     the feature).
+
+    ``dedup=True`` (the default) fingerprints each uncompiled (config,
+    bucket) key first and aliases it to an executable already built this
+    call when the fingerprints match (see the module docstring) — the
+    report's ``deduped`` counts the compiles avoided, and
+    ``new_compiles + deduped`` equals the number of keys warmed fresh.
+    ``dedup=False`` restores one compile per key (the fingerprint trace
+    itself is skipped too).
 
     ``tolerate_errors=True`` keeps warming the remaining programs when one
     compile fails (degraded startup beats no startup: a config whose compile
@@ -58,22 +80,41 @@ def warmup(engine, configs: Sequence[SamplerConfig],
     active_dir = enable_compile_cache(cache_dir) if persistent_cache else None
     before = engine.stats["compiles"]
     errors: dict = {}
+    deduped = 0
+    seen: dict = {}  # fingerprint -> (config, bucket) that compiled it
+    can_dedup = dedup and hasattr(engine, "program_fingerprint")
     for config in configs:
         for bucket in buckets:
+            key = (config, bucket)
             try:
-                engine.ensure_program(config, bucket)
+                fp = None
+                if can_dedup and key not in engine._programs:
+                    try:
+                        fp = engine.program_fingerprint(config, bucket)
+                    except Exception:  # noqa: BLE001 — trace-only accelerant:
+                        fp = None      # let the compile path raise its error
+                src = seen.get(fp) if fp is not None else None
+                if src is not None:
+                    engine.adopt_program(config, bucket, src)
+                    deduped += 1
+                else:
+                    engine.ensure_program(config, bucket)
+                    if fp is not None:
+                        seen[fp] = key
                 if config.cached:
                     engine.prewarm_cache(config, bucket)
             except Exception as exc:  # noqa: BLE001 — optionally isolated
                 if not tolerate_errors:
                     raise
-                errors[(config, bucket)] = exc
+                errors[key] = exc
     m = getattr(engine, "metrics", None)
     if m is not None:
         m.inc("warmup.new_compiles", engine.stats["compiles"] - before)
+        m.inc("warmup.deduped", deduped)
         m.gauge("warmup.programs", len(engine._programs))
     return {
         "new_compiles": engine.stats["compiles"] - before,
+        "deduped": deduped,
         "programs": len(engine._programs),
         "buckets": buckets,
         "configs": len(set(configs)),

@@ -1,0 +1,317 @@
+"""Progressive distillation: teacher takes two steps, student learns one.
+
+The served few-step samplers (``ops/sampling.ddim_sample_fewstep``,
+``SamplerConfig(steps=k)``) are only as good as the weights behind them — a
+k=20-trained x̂₀ predictor run at k=1 jumps straight from full noise to the
+clean image through coefficients it never saw. Progressive distillation
+(Salimans & Ho; the Efficient Diffusion Models survey's few-step axis)
+closes that gap with a halving loop: at each round the TEACHER runs two
+consecutive steps of its 2s-evaluation schedule and the STUDENT — same
+architecture, initialized from the teacher — learns to land on the
+teacher's two-step output in one update of its s-evaluation schedule. The
+round's student becomes the next round's teacher, so one k=20 model yields
+the whole k∈{…,4,2,1} family.
+
+Schedule consistency is what makes the pairing exact: every other entry of
+``fewstep_time_sequence(T, 2s)`` IS ``fewstep_time_sequence(T, s)``
+(ops/schedule.py), so student position j sits at teacher position 2j and
+the teacher's sub-steps (2j, 2j+1) end exactly where the student's single
+update j must land. The update math is the sampler's own affine form
+(``fewstep_coefficients``) — the student trains against the exact program
+serving dispatches, including the pinned jump-to-clean final row.
+
+Both degradation families are covered:
+
+* ``variant="ddim"`` — Gaussian forward noising at the drawn schedule level
+  (the dataset's ᾱ(t) = 1 − √((t+1)/T) convention), teacher sub-steps via
+  the affine DDIM update.
+* ``variant="cold"`` — the deterministic cold degradation
+  (ops/degrade.cold_degrade) at the drawn level; the naive cold update is
+  ``x ← clamp(f(x, t))``, so the teacher's two steps are two model
+  applications at consecutive schedule levels and the student matches the
+  second output directly.
+
+Training reuses the in-tree machinery end to end: ``EmaTrainState`` +
+``make_optimizer`` from train/step.py (clip → AdamW-cosine, optional EMA
+shadow), buffer donation on the jitted step, and orbax checkpoint/resume
+via utils/checkpoint (per-round student files plus a mid-round ``live``
+checkpoint, the trainer's template-restore idiom). The default
+:class:`DistillConfig` is CPU-smoke sized; scale ``iters``/``batch_size``
+up for a real run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddim_cold_tpu.ops import degrade, schedule
+from ddim_cold_tpu.train.step import EmaTrainState, make_optimizer
+from ddim_cold_tpu.utils import checkpoint as ckpt
+from ddim_cold_tpu.utils.logging import print_log
+
+
+def _log(msg: str, log: Optional[str]) -> None:
+    print(msg, flush=True)
+    if log:
+        print_log(msg, log)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillConfig:
+    """Knobs for one halving run. Defaults are CPU-smoke sized (seconds,
+    not hours) — a real run raises ``iters``/``batch_size``/``lr`` and
+    points ``checkpoint_dir`` somewhere durable."""
+
+    start_steps: int = 4      # first student's evaluation count
+    target_steps: int = 1     # halve until this count is reached
+    iters: int = 60           # optimizer updates per round
+    batch_size: int = 8
+    lr: float = 1e-4
+    variant: str = "ddim"     # "ddim" | "cold"
+    cold_levels: int = 6      # cold: the start degradation level L
+    ema_decay: float = 0.0    # > 0 keeps an EMA shadow of the student
+    log_every: int = 20
+    save_every: int = 0       # mid-round live-checkpoint cadence (0 = off)
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.target_steps < 1:
+            raise ValueError(
+                f"target_steps must be >= 1, got {self.target_steps}")
+        s = self.start_steps
+        if s < self.target_steps:
+            raise ValueError(
+                f"start_steps ({s}) must be >= target_steps "
+                f"({self.target_steps})")
+        while s > self.target_steps:
+            if s % 2:
+                raise ValueError(
+                    f"start_steps ({self.start_steps}) must reach "
+                    f"target_steps ({self.target_steps}) by halving")
+            s //= 2
+        if s != self.target_steps:
+            raise ValueError(
+                f"start_steps ({self.start_steps}) must reach target_steps "
+                f"({self.target_steps}) by halving")
+        if self.variant not in ("ddim", "cold"):
+            raise ValueError(
+                f"variant must be 'ddim' or 'cold', got {self.variant!r}")
+        if self.variant == "cold":
+            for s in self.round_steps():
+                if self.cold_levels % (2 * s):
+                    raise ValueError(
+                        f"cold_levels ({self.cold_levels}) must divide into "
+                        f"2x the round's step count (round steps={s}) so "
+                        "teacher/student level strides stay integral")
+        if self.iters < 1 or self.batch_size < 1:
+            raise ValueError("iters and batch_size must be >= 1")
+
+    def round_steps(self) -> list:
+        """Student evaluation counts, one per round: start, start/2, …,
+        target."""
+        out, s = [], self.start_steps
+        while s >= self.target_steps:
+            out.append(s)
+            if s == self.target_steps:
+                break
+            s //= 2
+        return out
+
+
+def synthetic_batch(rng: jax.Array, n: int, img_size, chans: int):
+    """Placeholder clean images for CPU smoke: piecewise-constant [−1, 1]
+    tiles (a 4×4 draw nearest-upsampled), so the distill loss has real
+    structure to fit without any dataset on disk."""
+    H, _ = img_size
+    tiles = jax.random.uniform(rng, (n, min(4, H), min(4, H), chans),
+                               jnp.float32, minval=-1.0, maxval=1.0)
+    return degrade.upsample_nearest(tiles, H)
+
+
+def make_distill_step(model, *, steps: int, variant: str = "ddim",
+                      cold_levels: int = 6,
+                      ema_decay: float = 0.0) -> Callable:
+    """``(state, teacher_params, x0, rng, loss_rec) →
+    (state, loss, loss_rec)``, jitted with the student state and the loss
+    EMA donated (train/step.py's calling convention).
+
+    The teacher forward runs under ``stop_gradient`` on separately passed
+    params — one program holds both; nothing about the teacher enters the
+    optimizer. Per example, a schedule position j is drawn uniformly, the
+    clean image is corrupted to the student's level t_j, the teacher takes
+    its two sub-steps (2j, 2j+1) and the student's single update j is
+    regressed onto the teacher's landing point (MSE in update space, so the
+    final jump-to-clean position degenerates to plain x̂₀ matching)."""
+    T = model.total_steps
+    if variant == "ddim":
+        c_s = schedule.fewstep_coefficients(T, steps)
+        c_t = schedule.fewstep_coefficients(T, 2 * steps)
+        t_s, t_t = c_s.t_seq, c_t.t_seq
+    else:
+        stride = cold_levels // steps
+        t_s = np.arange(cold_levels, 0, -stride, dtype=np.int32)
+        t_t = np.arange(cold_levels, 0, -stride // 2, dtype=np.int32)
+        c_s = c_t = None
+
+    def forward(params, x, t):
+        out = model.apply({"params": params}, x, t)
+        return jnp.clip(out, -1.0, 1.0)
+
+    def teacher_target(teacher_params, x, j):
+        """Two teacher sub-steps from student position j — the landing
+        point the student must reach in one update."""
+        tp = jax.lax.stop_gradient(teacher_params)
+        if variant == "ddim":
+            tt = jnp.asarray(t_t)
+            cx, cx0 = jnp.asarray(c_t.cx), jnp.asarray(c_t.cx0)
+            y = x
+            for sub in (2 * j, 2 * j + 1):
+                x0 = forward(tp, y, tt[sub])
+                y = (cx[sub][:, None, None, None] * y
+                     + cx0[sub][:, None, None, None] * x0)
+            return y
+        tt = jnp.asarray(t_t)
+        y = forward(tp, x, tt[2 * j])
+        return forward(tp, y, tt[2 * j + 1])
+
+    def loss_fn(params, teacher_params, x_t, j):
+        target = jax.lax.stop_gradient(
+            teacher_target(teacher_params, x_t, j))
+        x0_s = forward(params, x_t, jnp.asarray(t_s)[j])
+        if variant == "ddim":
+            cs = jnp.asarray(c_s.cx)[j][:, None, None, None]
+            cs0 = jnp.asarray(c_s.cx0)[j][:, None, None, None]
+            pred = cs * x_t + cs0 * x0_s
+        else:
+            pred = x0_s
+        return jnp.mean(jnp.square(pred - target))
+
+    @partial(jax.jit, donate_argnums=(0, 4))
+    def step(state, teacher_params, x0, rng, loss_rec):
+        rj, re = jax.random.split(rng)
+        n = x0.shape[0]
+        j = jax.random.randint(rj, (n,), 0, steps)
+        if variant == "ddim":
+            t = jnp.asarray(t_s)[j].astype(jnp.float32)
+            alpha = (1.0 - jnp.sqrt((t + 1.0) / T))[:, None, None, None]
+            eps = jax.random.normal(re, x0.shape, jnp.float32)
+            x_t = jnp.sqrt(alpha) * x0 + jnp.sqrt(1.0 - alpha) * eps
+        else:
+            x_t = degrade.cold_degrade(x0, jnp.asarray(t_s)[j],
+                                       size=x0.shape[1],
+                                       max_step=cold_levels)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, teacher_params, x_t, j)
+        state = state.apply_gradients(grads=grads)
+        if ema_decay:
+            state = state.replace(ema_params=jax.tree.map(
+                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                state.ema_params, state.params))
+        loss_rec = 0.99 * loss_rec + 0.01 * loss
+        return state, loss, loss_rec
+
+    return step
+
+
+def make_student_state(model, teacher_params, lr: float, total_iters: int,
+                       ema_decay: float = 0.0) -> EmaTrainState:
+    """A fresh optimizer wrapped around a COPY of the teacher's params —
+    the standard progressive-distillation init (the student starts as the
+    teacher and only has to learn the schedule compression)."""
+    params = jax.tree.map(jnp.copy, teacher_params)
+    state = EmaTrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=make_optimizer(lr, total_iters),
+        ema_params=jax.tree.map(jnp.copy, params) if ema_decay else None)
+    return state.replace(step=jnp.asarray(0, jnp.int32))
+
+
+def _round_template(state: EmaTrainState) -> dict:
+    """Checkpoint template (structure + dtypes) for the live mid-round
+    file — the trainer's template-restore idiom (utils/checkpoint)."""
+    return {"steps": 0, "iter": 0, "loss": 0.0,
+            "params": state.params, "opt_state": state.opt_state}
+
+
+def distill(model, teacher_params, config: DistillConfig = DistillConfig(),
+            *, batches: Optional[Callable] = None,
+            log=None) -> Dict[str, Any]:
+    """Run the halving loop; returns ``{"students": {steps: params},
+    "history": {steps: [logged losses]}, "final_steps": k}``.
+
+    ``batches`` is ``(rng) → (batch_size, H, W, C)`` clean images in
+    [−1, 1]; the default draws :func:`synthetic_batch` (CPU smoke). With
+    ``config.checkpoint_dir`` set, each finished round lands in
+    ``student_k<steps>/`` and a ``live/`` checkpoint makes mid-round
+    interrupts resumable — rerunning the same config skips completed
+    rounds entirely (their students restore from disk)."""
+    cfg = config
+    if batches is None:
+        H, W = model.img_size
+
+        def batches(rng):
+            return synthetic_batch(rng, cfg.batch_size, (H, W),
+                                   model.in_chans)
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    students: Dict[int, Any] = {}
+    history: Dict[int, list] = {}
+    teacher = teacher_params
+    live_dir = (os.path.join(cfg.checkpoint_dir, "live")
+                if cfg.checkpoint_dir else None)
+    for round_idx, steps in enumerate(cfg.round_steps()):
+        round_dir = (os.path.join(cfg.checkpoint_dir, f"student_k{steps}")
+                     if cfg.checkpoint_dir else None)
+        if round_dir and os.path.isdir(round_dir):
+            restored = ckpt.restore_checkpoint(
+                round_dir, target={"params": teacher})
+            students[steps] = teacher = restored["params"]
+            history[steps] = []
+            _log(f"distill round {round_idx} (k={steps}): restored "
+                 f"finished student from {round_dir}", log)
+            continue
+        state = make_student_state(model, teacher, cfg.lr, cfg.iters,
+                                   cfg.ema_decay)
+        start_iter = 0
+        if live_dir and os.path.isdir(live_dir):
+            live = ckpt.restore_checkpoint(live_dir,
+                                           target=_round_template(state))
+            if int(live["steps"]) == steps:
+                state = state.replace(params=live["params"],
+                                      opt_state=live["opt_state"])
+                start_iter = int(live["iter"])
+                _log(f"distill round {round_idx} (k={steps}): resumed "
+                     f"at iter {start_iter}", log)
+        step_fn = make_distill_step(model, steps=steps, variant=cfg.variant,
+                                    cold_levels=cfg.cold_levels,
+                                    ema_decay=cfg.ema_decay)
+        loss_rec = jnp.asarray(0.0, jnp.float32)
+        losses = []
+        for it in range(start_iter, cfg.iters):
+            rng, rb, rs = jax.random.split(rng, 3)
+            x0 = batches(rb)
+            state, loss, loss_rec = step_fn(state, teacher, x0, rs, loss_rec)
+            if cfg.log_every and (it + 1) % cfg.log_every == 0:
+                val = float(loss)
+                losses.append(val)
+                _log(f"distill k={steps} iter {it + 1:5d}/{cfg.iters} "
+                     f"loss {val:.6f}", log)
+            if live_dir and cfg.save_every and (it + 1) % cfg.save_every == 0:
+                ckpt.save_checkpoint(live_dir, {
+                    "steps": steps, "iter": it + 1, "loss": float(loss),
+                    "params": state.params, "opt_state": state.opt_state})
+        student = (state.ema_params if cfg.ema_decay else state.params)
+        if round_dir:
+            ckpt.save_checkpoint(round_dir, {"params": student})
+        students[steps] = teacher = student
+        history[steps] = losses
+    return {"students": students, "history": history,
+            "final_steps": cfg.target_steps}

@@ -119,6 +119,11 @@ def render(path: str) -> str:
         lines.append("")
         lines.append("**k-sweep 64px (img/s):** "
                      + " · ".join(f"k={k}: {v}" for k, v in ks.items()))
+    ksf = sub.get("ksweep_64px_fewstep_img_per_sec")
+    if ksf:
+        lines.append("few-step 64px (img/s, steps = total model "
+                     "applications): "
+                     + " · ".join(f"s={k}: {v}" for k, v in ksf.items()))
 
     q64 = sub.get("sampler_64px_w8a16")
     if q64:
@@ -153,6 +158,23 @@ def render(path: str) -> str:
                 f"({sq.get('vs_float_serving')}× float serving) · param bytes "
                 f"{sq.get('param_bytes')} → {sq.get('param_bytes_quant')} · "
                 f"compiles after warmup {sq.get('compiles_after_warmup')}")
+
+    fs = sub.get("fewstep")
+    if fs:
+        per = fs.get("per_k", {})
+        base = fs.get("baseline", {})
+        lines.append("")
+        lines.append(
+            "**few-step serving (img/s · n=1 latency):** "
+            + " · ".join(f"k={k}: {leg.get('img_per_sec')} / "
+                         f"{leg.get('latency_1_s')}s"
+                         for k, leg in per.items())
+            + f" · baseline k={base.get('k')} latency "
+              f"{base.get('latency_1_s')}s (k=1 ratio "
+              f"{fs.get('k1_latency_vs_baseline')}) · warmup "
+              f"{fs.get('warmup_new_compiles')} compiles + "
+              f"{fs.get('warmup_deduped')} deduped · compiles after warmup "
+              f"{fs.get('compiles_after_warmup')}")
 
     ca = sub.get("cache_adaptive")
     if ca:

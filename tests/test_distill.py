@@ -1,0 +1,279 @@
+"""Few-step distilled sampling tests (the k∈{1,2,4} serving family).
+
+Covers the full stack the feature spans: the fewstep scan's BITWISE
+contract against manually-indexed per-step DDIM updates (same traced
+arithmetic, no scan), the progressive-distillation loop (loss decreases,
+checkpoint/resume round-trip restores finished students bit-for-bit), the
+engine's first-class ``SamplerConfig(steps=k)`` programs (bitwise vs the
+direct sampler at two buckets, step-cache and w8a16 composition, student
+param routing), warmup fingerprint dedup (a student config aliases the
+teacher's executable instead of compiling), config validation at both
+layers, and the graftcheck J006 sweep registration.
+
+The bitwise reference deliberately runs ONE JITTED HELPER PER STEP with the
+schedule coefficients passed as TRACED scalars: that reproduces the scan
+body's exact fma contraction points. An eager python loop (or a fully
+unrolled jit with the coefficients baked as constants) differs by ~1 ulp at
+steps=4 — constant folding changes the contraction order — and would turn
+this into a flaky allclose test.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddim_cold_tpu import serve
+from ddim_cold_tpu.analysis import entries
+from ddim_cold_tpu.models import DiffusionViT
+from ddim_cold_tpu.ops import quant as quant_mod
+from ddim_cold_tpu.ops import sampling, schedule
+from ddim_cold_tpu.train import distill
+
+TINY = dict(img_size=(16, 16), patch_size=8, embed_dim=32, depth=2,
+            num_heads=4, total_steps=2000)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = DiffusionViT(**TINY)
+    x = jnp.zeros((2, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x,
+                        jnp.array([0, 1], jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def student_params(model_and_params):
+    """A 'student' tree distinguishable from the teacher — the routing
+    tests need outputs that differ, not a real distilled checkpoint."""
+    _, params = model_and_params
+    return jax.tree.map(lambda a: a + 1e-3, params)
+
+
+@pytest.fixture(scope="module")
+def warmed(model_and_params, student_params):
+    """One engine with every plain few-step program warmed at two buckets,
+    shared by the bitwise/routing tests (AOT compiles dominate runtime)."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4, 8),
+                       student_params=student_params)
+    cfgs = [serve.SamplerConfig(steps=s) for s in (1, 2, 4)]
+    report = serve.warmup(eng, cfgs, persistent_cache=False)
+    assert report["new_compiles"] == 6  # 3 step counts x 2 buckets
+    return eng
+
+
+def _direct_fewstep(model, params, seed, steps, n, **kw):
+    return np.asarray(sampling.ddim_sample_fewstep(
+        model, params, jax.random.PRNGKey(seed), steps=steps, n=n, **kw))
+
+
+# ------------------------------------------------------------ scan bitwise
+
+
+@pytest.mark.parametrize("steps", [1, 2, 4])
+def test_fewstep_scan_bitwise_vs_manual_steps(model_and_params, steps):
+    """The compiled scan program equals steps-many manually-indexed DDIM
+    updates (final jump-to-clean hoisted as a bare forward), bit for bit."""
+    model, params = model_and_params
+    n = 2
+    coeffs = schedule.fewstep_coefficients(model.total_steps, steps)
+
+    @jax.jit
+    def one_update(p, x, t, c1, c2):
+        x0 = jnp.clip(model.apply({"params": p}, x,
+                                  jnp.full((x.shape[0],), t, jnp.int32)),
+                      -1.0, 1.0)
+        return c1 * x + c2 * x0
+
+    @jax.jit
+    def final_forward(p, x, t):
+        x0 = jnp.clip(model.apply({"params": p}, x,
+                                  jnp.full((x.shape[0],), t, jnp.int32)),
+                      -1.0, 1.0)
+        return (x0 + 1.0) / 2.0
+
+    rng = jax.random.PRNGKey(7)
+    H, W = model.img_size
+    x = jax.random.normal(rng, (n, H, W, model.in_chans), jnp.float32)
+    cx = jnp.asarray(coeffs.cx)
+    cx0 = jnp.asarray(coeffs.cx0)
+    t_seq = jnp.asarray(coeffs.t_seq)
+    for j in range(steps - 1):
+        x = one_update(params, x, t_seq[j], cx[j], cx0[j])
+    ref = np.asarray(final_forward(params, x, t_seq[steps - 1]))
+    out = _direct_fewstep(model, params, 7, steps, n)
+    assert np.array_equal(out, ref)
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def test_fewstep_halving_schedule_nests():
+    """Every other level of the 2s-step sequence IS the s-step sequence —
+    the invariant progressive distillation (two teacher steps = one student
+    step) banks on."""
+    for s in (1, 2):
+        t2 = schedule.fewstep_time_sequence(2000, 2 * s)
+        t1 = schedule.fewstep_time_sequence(2000, s)
+        assert np.array_equal(t2[::2], t1)
+
+
+# -------------------------------------------------------------- distill
+
+
+def test_distill_ddim_loss_decreases():
+    model = DiffusionViT(**TINY)
+    teacher = model.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 16, 3)),
+                         jnp.array([0, 1], jnp.int32))["params"]
+    cfg = distill.DistillConfig(start_steps=2, target_steps=1, iters=40,
+                                batch_size=4, lr=1e-3, variant="ddim",
+                                log_every=10, seed=3)
+    out = distill.distill(model, teacher, cfg)
+    assert set(out["students"]) == {2, 1}
+    assert out["final_steps"] == 1
+    for steps, losses in out["history"].items():
+        assert len(losses) == 4
+        assert losses[-1] < losses[0], (
+            f"k={steps} distill loss did not decrease: {losses}")
+    # the k=1 student is servable through the few-step program
+    img = sampling.ddim_sample_fewstep(model, out["students"][1],
+                                       jax.random.PRNGKey(0), steps=1, n=2)
+    assert img.shape == (2, 16, 16, 3)
+
+
+def test_distill_checkpoint_resume_roundtrip(tmp_path):
+    model = DiffusionViT(**TINY)
+    teacher = model.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 16, 3)),
+                         jnp.array([0, 1], jnp.int32))["params"]
+    cfg = distill.DistillConfig(start_steps=2, target_steps=1, iters=6,
+                                batch_size=2, variant="ddim", log_every=0,
+                                checkpoint_dir=str(tmp_path), seed=5)
+    first = distill.distill(model, teacher, cfg)
+    again = distill.distill(model, teacher, cfg)
+    for steps, params in first["students"].items():
+        a = jax.tree.leaves(params)
+        b = jax.tree.leaves(again["students"][steps])
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    # every round was restored from its finished checkpoint, not retrained
+    assert all(not v for v in again["history"].values())
+
+
+def test_distillconfig_validation():
+    with pytest.raises(ValueError):  # 4 -> 3 is not a halving chain
+        distill.DistillConfig(start_steps=4, target_steps=3)
+    with pytest.raises(ValueError):
+        distill.DistillConfig(variant="sde")
+    with pytest.raises(ValueError):  # cold teacher needs 2*s | levels
+        distill.DistillConfig(start_steps=4, target_steps=1, variant="cold",
+                              cold_levels=6)
+    with pytest.raises(ValueError):
+        distill.DistillConfig(iters=0)
+
+
+# ------------------------------------------------------------ serving
+
+
+def test_engine_fewstep_bitwise_vs_direct_two_buckets(model_and_params,
+                                                      warmed):
+    model, params = model_and_params
+    eng = warmed
+    for steps in (1, 2, 4):
+        cfg = serve.SamplerConfig(steps=steps)
+        for n in (4, 8):  # one request per bucket
+            t = eng.submit(seed=40 + n, n=n, config=cfg)
+            report = eng.run()
+            assert report["compiles"] == 0
+            out = np.asarray(t.result(timeout=120))
+            assert np.array_equal(
+                out, _direct_fewstep(model, params, 40 + n, steps, n))
+
+
+def test_engine_fewstep_student_routing(model_and_params, student_params,
+                                        warmed):
+    """student=True dispatches the SAME program over the student tree —
+    bitwise the direct sampler on those params, and no new compile."""
+    model, params = model_and_params
+    eng = warmed
+    cfg = serve.SamplerConfig(steps=2, student=True)
+    serve.warmup(eng, [cfg], persistent_cache=False)  # aliases, no compile
+    t = eng.submit(seed=51, n=4, config=cfg)
+    report = eng.run()
+    assert report["compiles"] == 0
+    out = np.asarray(t.result(timeout=120))
+    assert np.array_equal(out, _direct_fewstep(model, student_params, 51,
+                                               2, 4))
+    assert not np.array_equal(out, _direct_fewstep(model, params, 51, 2, 4))
+
+
+def test_engine_fewstep_without_student_params_raises(model_and_params):
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,))
+    with pytest.raises(ValueError, match="student_params"):
+        eng.ensure_program(serve.SamplerConfig(steps=2, student=True), 4)
+
+
+def test_engine_fewstep_cached_and_quant_composition(model_and_params):
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4,))
+    cfg_c = serve.SamplerConfig(steps=4, cache_interval=2, cache_mode="full")
+    cfg_q = serve.SamplerConfig(steps=2, quant="xla")
+    serve.warmup(eng, [cfg_c, cfg_q], persistent_cache=False)
+    t_c = eng.submit(seed=60, n=4, config=cfg_c)
+    t_q = eng.submit(seed=61, n=4, config=cfg_q)
+    report = eng.run()
+    assert report["compiles"] == 0
+    assert np.array_equal(
+        np.asarray(t_c.result(timeout=120)),
+        _direct_fewstep(model, params, 60, 4, 4, cache_interval=2,
+                        cache_mode="full"))
+    assert np.array_equal(
+        np.asarray(t_q.result(timeout=120)),
+        _direct_fewstep(model.clone(quant="xla"),
+                        quant_mod.quantize_params(params), 61, 2, 4))
+
+
+def test_warmup_dedup_aliases_student_config(model_and_params,
+                                             student_params):
+    """The student config's trace fingerprints identical to the teacher's
+    (same jaxpr, same consts — params are call arguments), so warmup
+    compiles ONE program per bucket and aliases the other key."""
+    model, params = model_and_params
+    eng = serve.Engine(model, params, buckets=(4, 8),
+                       student_params=student_params)
+    cfgs = [serve.SamplerConfig(steps=2),
+            serve.SamplerConfig(steps=2, student=True)]
+    report = serve.warmup(eng, cfgs, persistent_cache=False)
+    assert report["new_compiles"] == 2
+    assert report["deduped"] == 2
+    assert report["programs"] == 4
+    assert eng.stats["program_aliases"] == 2
+    # dedup=False restores one compile per key
+    eng2 = serve.Engine(model, params, buckets=(4,),
+                        student_params=student_params)
+    report2 = serve.warmup(eng2, cfgs, persistent_cache=False, dedup=False)
+    assert report2["new_compiles"] == 2
+    assert report2["deduped"] == 0
+
+
+def test_samplerconfig_fewstep_validation():
+    with pytest.raises(ValueError, match="steps"):
+        serve.SamplerConfig(steps=-1)
+    with pytest.raises(ValueError, match="student"):
+        serve.SamplerConfig(student=True)
+    with pytest.raises(ValueError, match="few-step"):
+        serve.SamplerConfig(steps=2, sampler="cold")
+    with pytest.raises(ValueError, match="task"):
+        serve.SamplerConfig(steps=2, task="inpaint")
+    with pytest.raises(ValueError, match="telemetry"):
+        serve.SamplerConfig(steps=2, telemetry=True,
+                            cache_interval=2)
+    # the valid family
+    for s in (1, 2, 4):
+        assert serve.SamplerConfig(steps=s).steps == s
+
+
+def test_j006_sweep_registers_fewstep_programs():
+    labels = {label for label, _, _ in entries.serve_sweep()}
+    assert {"ddim_fs1", "ddim_fs2", "ddim_fs4", "ddim_fs4_ci2",
+            "ddim_fs2_pv1", "ddim_fs1_qxla"} <= labels

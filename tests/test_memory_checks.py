@@ -162,13 +162,17 @@ def test_clean_in_tree_memory(kernel_traces):
              for name, (e, c) in kernel_traces.items()
              if (e.meta or {}).get("memory")}
     assert set(peaks) == {"ns200_f32", "ns200_bf16", "ns200_w8a16",
-                          "ns200_w8a16_fused", "ns200_w8a8_fused"}
+                          "ns200_w8a16_fused", "ns200_w8a8_fused",
+                          "ns200_fewstep4_bf16"}
     for name, peak in peaks.items():
         assert 10 * 2**20 < peak < 2**31, (name, peak)
     # quantized weights must not peak above the f32 build
     assert peaks["ns200_w8a16"] < peaks["ns200_f32"]
     # fusing deletes intermediates; it must not grow the liveness peak
     assert peaks["ns200_w8a16_fused"] <= peaks["ns200_w8a16"] * 1.05
+    # the few-step scan holds one sampler state, not k of them — its peak
+    # stays in family with the stride sampler at the same dtype
+    assert peaks["ns200_fewstep4_bf16"] <= peaks["ns200_bf16"] * 1.05
 
 
 def test_budget_report_rollups(kernel_traces):
@@ -180,5 +184,6 @@ def test_budget_report_rollups(kernel_traces):
     assert 0 < report["max_kernel_vmem_mb"] <= report["vmem_budget_mib"]
     assert set(report["programs"]) == {"ns200_f32", "ns200_bf16",
                                        "ns200_w8a16", "ns200_w8a16_fused",
-                                       "ns200_w8a8_fused"}
+                                       "ns200_w8a8_fused",
+                                       "ns200_fewstep4_bf16"}
     assert len(report["kernels"]) >= 10
