@@ -211,6 +211,17 @@ def main(argv=None):
                          "compiles-after-warmup (MUST be 0, replacement "
                          "included); composes with --smoke for a CPU-budget "
                          "run")
+    ap.add_argument("--fleet-proc", action="store_true",
+                    help="run the out-of-process fleet leg (serve/remote.py): "
+                         "a Router over TWO subprocess replicas (each its own "
+                         "OS process speaking the socket RPC) serving a mixed "
+                         "stream while a seeded chaos schedule SIGKILLs r0 "
+                         "mid-drain and sprays rpc latency; records "
+                         "spawn-warmup wall warm vs cold (the persistent "
+                         "compile cache), kill-to-recovered latency, and the "
+                         "autoscaler converging back to target; raises on any "
+                         "compile after warmup or a non-bitwise survivor; "
+                         "composes with --smoke for a CPU-budget run")
     ap.add_argument("--edit", action="store_true",
                     help="run the guided-editing workloads leg "
                          "(ddim_cold_tpu/workloads): all four tasks "
@@ -1500,6 +1511,212 @@ def main(argv=None):
 
         if args.fleet:
             section("fleet", run_fleet)
+
+        def run_fleet_proc():
+            # the out-of-process fleet leg: same contract as run_fleet, but
+            # each replica is its own OS PROCESS behind serve/remote.py's
+            # socket RPC, and the chaos is real — a SIGKILL inside r0
+            # mid-drain (armed in the CHILD only, via its env) plus parent-
+            # side rpc latency. What this leg proves and records:
+            #   * survivors complete BITWISE vs direct sampling (failover
+            #     re-places the dead replica's queued tickets);
+            #   * a replacement spawns from the persistent compile cache the
+            #     first replicas populated — spawn+warm wall time cold
+            #     (empty cache) vs warm (replacement) is THE pre-warmed-
+            #     spawn number;
+            #   * compiles-after-warmup stays 0 fleet-wide (the spawn path
+            #     asserts it per replica; the router sums it);
+            #   * the autoscaler scales up under queue pressure and
+            #     converges back to the floor without flapping.
+            from ddim_cold_tpu import serve
+            from ddim_cold_tpu.ops import sampling
+            from ddim_cold_tpu.serve import remote as sv_remote
+            from ddim_cold_tpu.utils import faults as fj
+
+            buckets = (2, 4) if args.smoke else (8, 32)
+            k_serve = 400 if args.smoke else 20
+            bmax = max(buckets)
+            cfg = serve.SamplerConfig(k=k_serve)
+            sizes = [bmax, 1, bmax // 2, bmax - 1, bmax // 2 + 1, bmax]
+            tmp = tempfile.mkdtemp(prefix="ddim_fleet_proc_")
+            cache_dir = os.path.join(tmp, "compile_cache")
+            params_npz = sv_remote.save_params_npz(
+                os.path.join(tmp, "params.npz"),
+                jax.device_get(state.params))
+            spec = {"backend": "engine",
+                    "model": dict(MODEL_CONFIGS["vit_tiny"],
+                                  dtype="bfloat16"),
+                    "params_npz": params_npz,
+                    "engine": {"buckets": list(buckets)},
+                    "cache_dir": cache_dir}
+            # children always run on CPU: two processes cannot share one
+            # TPU, and this leg measures lifecycle latency (spawn, warm,
+            # kill, recover), not device throughput. The kill spec rides the
+            # child env so ONLY replica r0 ever arms it (its 2nd work frame
+            # lands mid-stream — a SIGKILL mid-drain).
+            child_env = {
+                "JAX_PLATFORMS": "cpu",
+                "DDIM_COLD_FAULTS":
+                    "replica.kill:kill:at=1,match=replica:r0|"}
+            reps = {}
+            factory = sv_remote.remote_factory(
+                spec, env=child_env, heartbeat_s=1.0, miss_budget=5,
+                spawn_timeout_s=600.0, rpc_timeout_s=120.0)
+
+            def tracking(rid):
+                rep = factory(rid)
+                reps[rid] = rep
+                return rep
+
+            mark(f"fleet-proc spawn+warm 2 subprocess replicas "
+                 f"buckets={buckets}", budget_s=6 * stall_s)
+            router = serve.Router(tracking, replicas=2, configs=[cfg],
+                                  buckets=buckets, max_hedges=2,
+                                  drain_timeout_s=300)
+            try:
+                mark("fleet-proc chaos stream", budget_s=6 * stall_s)
+                t_stream = time.perf_counter()
+                with fj.inject(fj.FaultSpec("rpc.latency", "latency",
+                                            rate=0.2, seed=13,
+                                            latency_s=0.02)) as plan:
+                    tickets = [(700 + i,
+                                router.submit(seed=700 + i, n=n_req,
+                                              config=cfg))
+                               for i, n_req in enumerate(sizes)]
+                    # recovery clock: kill detected → replacement READY
+                    t_detect = t_ready = None
+                    deadline = time.perf_counter() + 600
+                    while time.perf_counter() < deadline:
+                        h = router.health()
+                        now = time.perf_counter()
+                        if t_detect is None and h["retired_replicas"] >= 1:
+                            t_detect = now
+                        if (t_detect is not None and t_ready is None
+                                and h["active_replicas"] == 2):
+                            t_ready = now
+                            break
+                        time.sleep(0.1)
+                    errs = [t.exception(timeout=900) for _, t in tickets]
+                    injected = len(plan.realized)
+                wall = time.perf_counter() - t_stream
+                survivors = sum(1 for e in errs if e is None)
+                if survivors < len(sizes):
+                    bad = next(e for e in errs if e is not None)
+                    raise RuntimeError(
+                        f"{len(sizes) - survivors} ticket(s) lost to the "
+                        f"kill (failover must complete them): {bad}")
+                # bitwise contract: every survivor row-set equals direct
+                # sampling with the same seed (CPU parent only — a bf16 TPU
+                # parent and a CPU child legitimately differ)
+                bitwise = None
+                if jax.default_backend() == "cpu":
+                    mark("fleet-proc bitwise check vs direct")
+                    for (seed, t), n_req in zip(tickets, sizes):
+                        direct = np.asarray(sampling.ddim_sample(
+                            model, state.params, jax.random.PRNGKey(seed),
+                            k=k_serve, n=n_req))
+                        if not np.array_equal(np.asarray(t.result()),
+                                              direct):
+                            raise RuntimeError(
+                                f"survivor seed {seed} NOT bitwise vs "
+                                "direct sampling after failover")
+                    bitwise = True
+                # autoscaler: queue pressure → up, then converge back to
+                # the floor with no flapping (ticks driven here so the leg
+                # is deterministic about WHEN decisions happen)
+                mark("fleet-proc autoscale convergence", budget_s=6 * stall_s)
+                scaler = serve.Autoscaler(
+                    router, min_replicas=2, max_replicas=3,
+                    queue_high=1.0, queue_low=0.5,
+                    up_ticks=2, down_ticks=2, cooldown_s=0.0)
+                actions = []
+                burst = [router.submit(seed=800 + i, n=bmax, config=cfg)
+                         for i in range(4)]
+                deadline = time.perf_counter() + 900
+                while time.perf_counter() < deadline:
+                    actions.append(scaler.tick()["action"])
+                    if all(t.done for t in burst):
+                        break
+                    time.sleep(0.5)
+                for t in burst:
+                    t.result(timeout=900)
+                idle_tail = []
+                for _ in range(8):  # drained fleet: must walk back to floor
+                    idle_tail.append(scaler.tick()["action"])
+                    time.sleep(0.05)
+                actions += idle_tail
+                ups = actions.count("up")
+                downs = actions.count("down")
+                if router.target != scaler.floor or ups != downs:
+                    raise RuntimeError(
+                        f"autoscaler did not converge: target "
+                        f"{router.target} vs floor {scaler.floor}, "
+                        f"{ups} ups / {downs} downs ({actions})")
+                if any(a is not None for a in idle_tail[-4:]):
+                    raise RuntimeError(
+                        f"autoscaler flapping on an idle fleet: {idle_tail}")
+                health = router.drain(timeout=300)
+                if health["compiles_after_warmup"] != 0:
+                    raise RuntimeError(
+                        "fleet-proc zero-compile contract broken: "
+                        f"{health['compiles_after_warmup']} compiles after "
+                        "warmup (the replacement must warm from the "
+                        "persistent cache)")
+                # spawn+warm walls: r0/r1 paid the COLD compile (empty
+                # cache); every later spawn warmed from the populated one
+                cold = [reps[r] for r in ("r0", "r1") if r in reps]
+                warm = [rep for rid, rep in sorted(reps.items())
+                        if rid not in ("r0", "r1")]
+                def spawn_warm(rs):
+                    return round(max(r.spawn_s + (r.warm_s or 0.0)
+                                     for r in rs), 2) if rs else None
+                sub["fleet_proc"] = {
+                    "replicas": 2, "backend": "subprocess",
+                    "img_per_sec": round(sum(sizes) / wall, 2),
+                    "survivors": survivors, "bitwise_vs_direct": bitwise,
+                    "rpc_latency_injected": injected,
+                    "failovers": health["failovers"],
+                    "hedges": health["hedges"],
+                    "replicas_retired": health["retired_replicas"],
+                    "replicas_spawned": health["replicas_spawned"],
+                    "compiles_after_warmup":
+                        health["compiles_after_warmup"],
+                    "kill_to_recovered_s":
+                        round(t_ready - t_detect, 2)
+                        if t_detect and t_ready else None,
+                    "spawn_warm_cold_s": spawn_warm(cold),
+                    "spawn_warm_s": spawn_warm(warm),
+                    "replacement_new_compiles":
+                        max((r.warm_report or {}).get("new_compiles", 0)
+                            for r in warm) if warm else None,
+                    "autoscale": {"scale_ups": ups, "scale_downs": downs,
+                                  "final_target": router.target,
+                                  "floor": scaler.floor},
+                    "stream_sizes": sizes, "buckets": list(buckets),
+                    "k": k_serve,
+                }
+                log(f"fleet-proc: {survivors}/{len(sizes)} tickets through "
+                    f"the SIGKILL (bitwise={bitwise}), kill→recovered "
+                    f"{sub['fleet_proc']['kill_to_recovered_s']}s, "
+                    f"spawn+warm cold {sub['fleet_proc']['spawn_warm_cold_s']}s "
+                    f"vs warm {sub['fleet_proc']['spawn_warm_s']}s, "
+                    f"autoscale {ups} up / {downs} down → target "
+                    f"{router.target}; compiles after warmup: "
+                    f"{health['compiles_after_warmup']}")
+            finally:
+                try:
+                    router.drain(timeout=60)
+                except Exception:  # noqa: BLE001 — already drained above
+                    pass
+                for rep in reps.values():
+                    try:
+                        rep._proc.kill()  # no child outlives the bench
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        if args.fleet_proc:
+            section("fleet_proc", run_fleet_proc, retries=0)
 
         def run_edit():
             # the guided-editing leg (ddim_cold_tpu/workloads): every task

@@ -69,7 +69,15 @@ HOST_ONLY_MODULES = ("ddim_cold_tpu/serve/batching.py",
                      # never saw the device; importing jax there would drag
                      # a backend init into every report render
                      "ddim_cold_tpu/obs/attrib.py",
-                     "ddim_cold_tpu/obs/trend.py")
+                     "ddim_cold_tpu/obs/trend.py",
+                     # the process boundary: the parent-side RPC handle and
+                     # autoscaler never touch a device, and the replica
+                     # server must boot to its hello without one — engine
+                     # construction hides behind serve/backend.py (the one
+                     # jax-touching import, deferred inside the child)
+                     "ddim_cold_tpu/serve/remote.py",
+                     "ddim_cold_tpu/serve/autoscale.py",
+                     "ddim_cold_tpu/serve/replica_main.py")
 
 #: obs.metrics emit methods (rule A005) → the registry kind they imply
 _METRIC_EMITS = ("inc", "gauge", "observe")

@@ -47,6 +47,8 @@ HOST_THREADED_MODULES = (
     "ddim_cold_tpu/serve/engine.py",
     "ddim_cold_tpu/serve/fleet.py",
     "ddim_cold_tpu/serve/router.py",
+    "ddim_cold_tpu/serve/remote.py",
+    "ddim_cold_tpu/serve/autoscale.py",
     "ddim_cold_tpu/obs/metrics.py",
     "ddim_cold_tpu/obs/spans.py",
     "ddim_cold_tpu/utils/watchdog.py",
@@ -61,6 +63,13 @@ LOCK_RANKS = {
     "ddim_cold_tpu/serve/router.py::_lock": 0,
     "ddim_cold_tpu/serve/engine.py::_lock": 10,
     "ddim_cold_tpu/serve/fleet.py::_lock": 10,
+    # remote handle: registry lock, then the send lock (framed writes
+    # serialize under it while the registry stays free for the reader)
+    "ddim_cold_tpu/serve/remote.py::_lock": 10,
+    "ddim_cold_tpu/serve/remote.py::_send_lock": 11,
+    # the autoscaler only guards its own thread handle; router calls
+    # (rank 0) always happen lock-free from the tick path
+    "ddim_cold_tpu/serve/autoscale.py::_lock": 10,
     "ddim_cold_tpu/serve/batching.py::_lock": 20,
     "ddim_cold_tpu/serve/batching.py::_pcond": 21,
     "ddim_cold_tpu/obs/metrics.py::_lock": 30,

@@ -83,6 +83,16 @@ METRICS = (
     # -- fleet ------------------------------------------------------------
     ("fleet.replica_transitions", "counter",
      "replica lifecycle transitions (key: state)"),
+    # -- remote replicas (serve/remote.py, one scope per handle) ----------
+    ("remote.rpc_calls", "counter", "RPC round trips issued (key: method)"),
+    ("remote.crashes", "counter",
+     "replica process deaths detected (exit or heartbeat loss)"),
+    ("remote.heartbeat_misses", "counter", "heartbeat pings that timed out"),
+    # -- autoscaler (serve/autoscale.py) ----------------------------------
+    ("autoscale.ticks", "counter", "control-loop decisions evaluated"),
+    ("autoscale.scale_ups", "counter", "target increments issued"),
+    ("autoscale.scale_downs", "counter", "target decrements issued"),
+    ("autoscale.target", "gauge", "router replica target after last tick"),
     # -- fault injection --------------------------------------------------
     ("faults.injected", "counter", "realized fault injections (key: site)"),
     # -- attribution / trend (obs.attrib / obs.trend, host-side) ----------

@@ -31,22 +31,31 @@ variants — ``workloads.default_edit_configs()`` is the warmable set, and
 ``Ticket.previews()``.
 """
 
+from ddim_cold_tpu.serve.autoscale import Autoscaler
 from ddim_cold_tpu.serve.batching import (BatchPlan, Request, SamplerConfig,
                                           Ticket, cover_rows, plan_batches,
                                           select_bucket)
 from ddim_cold_tpu.serve.engine import Engine
 from ddim_cold_tpu.serve.errors import (RETRYABLE_EXCEPTIONS, DeadlineExceeded,
                                         EngineClosedError, EngineStalledError,
-                                        QueueFullError, RequestFailedError,
+                                        QueueFullError, RemoteRPCError,
+                                        ReplicaCrashedError,
+                                        ReplicaUnreachableError,
+                                        RequestFailedError,
                                         RequestQuarantinedError, ServeError)
 from ddim_cold_tpu.serve.fleet import LocalReplica, ReplicaHandle, local_factory
+from ddim_cold_tpu.serve.remote import (RemoteReplica, remote_factory,
+                                        save_params_npz)
 from ddim_cold_tpu.serve.router import Router
 from ddim_cold_tpu.serve.warmup import warmup
 
 __all__ = [
-    "BatchPlan", "DeadlineExceeded", "Engine", "EngineClosedError",
-    "EngineStalledError", "LocalReplica", "QueueFullError", "ReplicaHandle",
-    "Request", "RequestFailedError", "RequestQuarantinedError",
-    "RETRYABLE_EXCEPTIONS", "Router", "SamplerConfig", "ServeError", "Ticket",
-    "cover_rows", "local_factory", "plan_batches", "select_bucket", "warmup",
+    "Autoscaler", "BatchPlan", "DeadlineExceeded", "Engine",
+    "EngineClosedError", "EngineStalledError", "LocalReplica",
+    "QueueFullError", "RemoteReplica", "RemoteRPCError", "ReplicaCrashedError",
+    "ReplicaHandle", "ReplicaUnreachableError", "Request",
+    "RequestFailedError", "RequestQuarantinedError", "RETRYABLE_EXCEPTIONS",
+    "Router", "SamplerConfig", "ServeError", "Ticket", "cover_rows",
+    "local_factory", "plan_batches", "remote_factory", "save_params_npz",
+    "select_bucket", "warmup",
 ]

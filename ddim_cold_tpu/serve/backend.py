@@ -1,0 +1,58 @@
+"""Child-process engine construction — the jax-touching half of
+serve/replica_main.py.
+
+replica_main must stay statically host-only (graftcheck A004: no jax
+attribute chains anywhere in the file), but an ``"engine"``-backend replica
+obviously needs a model, params, and a jitted Engine. That construction
+lives HERE, behind one deferred import, so the A004 boundary stays honest:
+everything the parent process imports (remote.py, replica_main.py) is
+host-only; the device stack loads only inside the child that serves on it.
+
+Spec fields consumed (see :func:`~ddim_cold_tpu.serve.remote.remote_factory`
+for the full grammar): ``model`` (DiffusionViT kwargs with ``dtype`` as a
+string and ``img_size`` as a list), ``params_npz`` (a tree saved by
+:func:`~ddim_cold_tpu.serve.remote.save_params_npz` — how trained params
+cross the process boundary) or ``init_seed`` (deterministic re-init — two
+replicas built from the same seed hold bitwise-equal params), ``engine``
+(Engine kwargs: buckets, max_queue, ...).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddim_cold_tpu.models.vit import DiffusionViT
+from ddim_cold_tpu.serve.engine import Engine
+from ddim_cold_tpu.serve.fleet import LocalReplica
+from ddim_cold_tpu.serve.remote import load_params_npz
+
+#: spec-string → jnp dtype (specs are JSON; a dtype object does not travel)
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+           "float16": jnp.float16}
+
+
+def build_model(model_spec: dict) -> DiffusionViT:
+    kw = dict(model_spec or {})
+    dtype = _DTYPES[kw.pop("dtype", "float32")]
+    if "img_size" in kw:
+        kw["img_size"] = tuple(kw["img_size"])
+    return DiffusionViT(dtype=dtype, **kw)
+
+
+def init_params(model: DiffusionViT, seed: int):
+    h, w = tuple(model.img_size)
+    x = jnp.zeros((1, h, w, model.in_chans), model.dtype)
+    t = jnp.zeros((1,), jnp.int32)
+    return model.init(jax.random.PRNGKey(int(seed)), x, t)["params"]
+
+
+def build_local_replica(replica_id: str, spec: dict) -> LocalReplica:
+    model = build_model(spec.get("model"))
+    if spec.get("params_npz"):
+        params = load_params_npz(spec["params_npz"])
+    else:
+        params = init_params(model, spec.get("init_seed", 0))
+    engine = Engine(model, params, replica_id=replica_id,
+                    **(spec.get("engine") or {}))
+    return LocalReplica(engine)

@@ -1136,10 +1136,16 @@ class Engine:
             mark_t, mark_label = self._last_mark
         now = time.monotonic()
         s = self.stats
+        lat = latency_summary(s["latencies_s"])
         return {
             "replica": self.replica_id,
             "queue_depth": depth,
             "open_tickets": open_n,
+            # per-ticket submit→deliver latency percentiles — the load
+            # signal the fleet autoscaler scales on (serve/autoscale.py)
+            "latency_p50_s": lat["p50_s"],
+            "latency_p95_s": lat["p95_s"],
+            "latency_p99_s": lat["p99_s"],
             "max_queue": self.max_queue,
             "uptime_s": now - self._t0,
             "last_progress_s": now - mark_t,

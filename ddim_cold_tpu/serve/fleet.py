@@ -39,6 +39,15 @@ from ddim_cold_tpu.obs import metrics
 NEW, READY, DRAINING, CLOSED = "new", "ready", "draining", "closed"
 
 
+def record_transition(scope, state: str) -> None:
+    """The ONE emit site for replica lifecycle transitions (graftcheck
+    A005 allows a metric name at one site) — every ReplicaHandle backend
+    (local thread, subprocess RPC) funnels its state changes through here,
+    so a chaos run's replica churn is countable without scraping router
+    internals."""
+    scope.inc("fleet.replica_transitions", key=state)
+
+
 class ReplicaHandle:
     """The router's view of one replica. Subclass per backend; every method
     is called from the router's control thread (plus ``submit`` from the
@@ -104,10 +113,10 @@ class LocalReplica(ReplicaHandle):
 
     def _set_state(self, state: str) -> None:
         """The one state-write site: every lifecycle transition lands in the
-        obs registry keyed by the state entered, so a chaos run's replica
-        churn is countable without scraping router internals."""
+        obs registry keyed by the state entered (via the module-level
+        single emit site shared with the subprocess backend)."""
         self.state = state
-        self.metrics.inc("fleet.replica_transitions", key=state)
+        record_transition(self.metrics, state)
 
     def warm(self, configs, buckets=None, **kwargs) -> dict:
         from ddim_cold_tpu.serve.warmup import warmup
@@ -158,6 +167,18 @@ class LocalReplica(ReplicaHandle):
     # -------------------------------------------------------------- serving
 
     def submit(self, *args, **kwargs):
+        # Guard the health()-snapshot → submit() window: a replica that
+        # drained between the router's candidate scan and its placement must
+        # raise the TYPED eviction error (the router's cue to try the next
+        # candidate), never a raw engine RuntimeError. The engine's own
+        # closed-check rides behind this for the race where drain lands
+        # mid-call.
+        if self.state != READY:
+            from ddim_cold_tpu.serve.errors import EngineClosedError
+
+            raise EngineClosedError(
+                f"replica {self.replica_id} is {self.state}, not ready — "
+                "placement raced a drain; retry on another replica")
         ticket = self.engine.submit(*args, **kwargs)
         self._work.set()
         return ticket

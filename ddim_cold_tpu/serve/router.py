@@ -249,6 +249,15 @@ class Router:
             reps = list(self._replicas.items())
             closed = self._closed
         for rid, rep in reps:
+            if rep.state in (fleet.DRAINING, fleet.CLOSED):
+                # the replica left READY on its own — a subprocess handle
+                # that detected its process dead self-transitions to closed
+                # (crash detection), and an in-process replica can be
+                # drained behind the router's back. Either way it can never
+                # serve again (lifecycle is one-way): retire the
+                # bookkeeping so a replacement spawns below.
+                self._retire(rid, rep)
+                continue
             if rep.state != fleet.READY:
                 continue
             try:
@@ -274,6 +283,47 @@ class Router:
                 # failure: count it, retry on the next tick
                 self.metrics.inc("router.spawn_failures")
                 return
+
+    # -------------------------------------------------------------- scaling
+
+    @property
+    def target(self) -> int:
+        """The replica count supervision converges the fleet to."""
+        return self._target
+
+    def scale_to(self, n: int) -> int:
+        """Move the supervision target to ``n`` (the autoscaler's one
+        lever). Scale-DOWN retires the least-loaded ready replicas
+        immediately (their queued tickets fail over through the normal
+        eviction path — no request is lost to a scale decision);
+        scale-UP is left to the next supervision tick, which already owns
+        spawn-with-retry. Returns the clamped target."""
+        n = max(1, int(n))
+        with self._lock:
+            if self._closed:
+                return self._target
+            self._target = n
+            excess = len(self._replicas) - n
+        if excess > 0:
+            victims = []
+            with self._lock:
+                ready = [(rid, rep) for rid, rep in self._replicas.items()
+                         if rep.state == fleet.READY]
+            scored = []
+            for rid, rep in ready:
+                try:
+                    h = rep.health()
+                except Exception:  # noqa: BLE001 — unreachable sorts first
+                    scored.append((-1, rid, rep))
+                    continue
+                scored.append((h.get("queue_depth", 0)
+                               + h.get("open_tickets", 0), rid, rep))
+            scored.sort(key=lambda s: (s[0], s[1]))
+            victims = [(rid, rep) for _, rid, rep in scored[:excess]]
+            for rid, rep in victims:
+                self._retire(rid, rep)
+        self._kick.set()
+        return n
 
     # -------------------------------------------------------------- admission
 
@@ -460,6 +510,13 @@ class Router:
                 if att is not None:
                     att.end(outcome="backpressure")
                 continue  # replica-level backpressure: next candidate
+            except RETRYABLE_EXCEPTIONS:
+                # transient boundary failure (unreachable RPC replica,
+                # dropped frame): the request is NOT consumed — try the
+                # next candidate, supervision decides the replica's fate
+                if att is not None:
+                    att.end(outcome="unreachable")
+                continue
             except Exception as exc:  # noqa: BLE001 — a replica whose
                 # submit breaks outright cannot hold the request
                 if att is not None:

@@ -31,11 +31,22 @@ raises :class:`PermanentFault` (deterministic — the engine bisects the
 batch and quarantines the poisoned request), ``latency`` sleeps
 ``latency_s``, ``corrupt`` flips one element of the call's payload buffer
 (NaN for float dtypes) chosen by the spec's RNG.
+
+Process-level kinds (the out-of-process fleet's chaos surface —
+serve/remote.py + serve/replica_main.py): ``kill`` SIGKILLs the CALLING
+process (fired inside a replica server it is the no-warning crash the
+RPC handle's crash detection must catch), ``hang`` sleeps ``hang_s``
+(default effectively forever — the wedged-replica case a heartbeat miss
+budget retires). The matching sites are ``replica.kill`` /
+``replica.hang`` (fired by the replica server per request) and
+``rpc.drop`` / ``rpc.latency`` (fired by the client around every frame
+send, so a chaos schedule can break the wire itself).
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
 from contextlib import contextmanager
@@ -57,8 +68,12 @@ _METRICS = _obs_metrics.scope("faults")
 SITES = ("serve.assemble", "serve.dispatch", "serve.fetch", "serve.compile",
          "serve.preview",
          "ckpt.save", "data.next",
-         "router.place", "router.failover", "replica.spawn")
-KINDS = ("transient", "permanent", "latency", "corrupt")
+         "router.place", "router.failover", "replica.spawn",
+         # the process boundary (serve/remote.py + serve/replica_main.py):
+         # fired server-side per work request (kill/hang) and client-side
+         # around every RPC frame (drop/latency)
+         "replica.kill", "replica.hang", "rpc.drop", "rpc.latency")
+KINDS = ("transient", "permanent", "latency", "corrupt", "kill", "hang")
 
 
 class FaultError(Exception):
@@ -101,6 +116,9 @@ class FaultSpec:
     rate: float = 1.0
     seed: int = 0
     latency_s: float = 0.05
+    #: ``hang`` kind only: how long the hung call sleeps. The default is
+    #: "longer than any heartbeat budget" — a hang is a wedge, not a blip.
+    hang_s: float = 3600.0
     max_fires: Optional[int] = None
     match: Optional[str] = None
     at: Optional[tuple] = None
@@ -297,6 +315,14 @@ def _fire(site: str, tag: str, payload):
     for spec, _ in fired:
         if spec.kind == "latency":
             time.sleep(spec.latency_s)
+    for spec, _ in fired:
+        if spec.kind == "hang":
+            time.sleep(spec.hang_s)
+    for spec, _ in fired:
+        if spec.kind == "kill":
+            # the no-warning crash: the process dies HERE, mid-request —
+            # nothing after this line runs, no socket close, no drain
+            os.kill(os.getpid(), signal.SIGKILL)
     for spec, at_call in fired:
         if spec.kind == "transient":
             raise TransientFault(
@@ -326,7 +352,7 @@ def parse_specs(text: str) -> tuple:
             for item in bits[2].split(","):
                 k, _, v = item.partition("=")
                 k, v = k.strip(), v.strip()
-                if k in ("rate", "latency_s"):
+                if k in ("rate", "latency_s", "hang_s"):
                     kw[k] = float(v)
                 elif k in ("seed", "max_fires"):
                     kw[k] = int(v)

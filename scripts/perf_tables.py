@@ -226,6 +226,24 @@ def render(path: str) -> str:
             f"{ft.get('replicas_spawned')} · compiles after warmup "
             f"{ft.get('compiles_after_warmup')}")
 
+    fp = sub.get("fleet_proc")
+    if fp:
+        asc = fp.get("autoscale") or {}
+        lines.append("")
+        lines.append(
+            f"**fleet (multi-process):** {fp.get('replicas')} subprocess "
+            f"replicas · {fp.get('img_per_sec')} img/s through a SIGKILL "
+            f"mid-drain · survivors {fp.get('survivors')} "
+            f"(bitwise={fp.get('bitwise_vs_direct')}) · kill→recovered "
+            f"{fp.get('kill_to_recovered_s')}s · spawn+warm cold "
+            f"{fp.get('spawn_warm_cold_s')}s / warm {fp.get('spawn_warm_s')}s "
+            f"· failovers {fp.get('failovers')} · retired "
+            f"{fp.get('replicas_retired')}/spawned "
+            f"{fp.get('replicas_spawned')} · autoscale "
+            f"{asc.get('scale_ups')}↑/{asc.get('scale_downs')}↓ → target "
+            f"{asc.get('final_target')} · compiles after warmup "
+            f"{fp.get('compiles_after_warmup')}")
+
     ed = sub.get("edit")
     if ed:
         per = ed.get("per_task", {})

@@ -1,0 +1,325 @@
+"""Autoscaler tests (serve/autoscale.py + Router.scale_to): hysteresis /
+cooldown / min-max units on a stub router with an injectable clock (no
+sleeping, no threads), scale-up under synthetic queue-depth pressure
+through a real Router over stub replicas, and no-flapping under a noisy
+p95 signal. The control decisions are pure functions of (health snapshot,
+clock), so every test drives ``tick()`` directly and asserts the exact
+``scale_to`` call sequence."""
+
+import time
+
+import pytest
+
+from ddim_cold_tpu.serve import fleet
+from ddim_cold_tpu.serve.autoscale import Autoscaler
+from ddim_cold_tpu.serve.router import Router
+
+
+class FakeRouter:
+    """Health-programmable router: the autoscaler only reads ``health()``/
+    ``target`` and calls ``scale_to`` — three knobs, no threads."""
+
+    def __init__(self, target=2):
+        self.target = target
+        self.calls = []
+        self.replicas = {f"r{i}": {"state": "ready", "queue_depth": 0,
+                                   "open_tickets": 0, "latency_p95_s": 0.0}
+                         for i in range(target)}
+        self.pending = {}
+        self.closed = False
+
+    def set_load(self, queue_depth=0, p95_s=0.0, pending=0):
+        for r in self.replicas.values():
+            r["queue_depth"] = queue_depth
+            r["latency_p95_s"] = p95_s
+        self.pending = {"default": pending} if pending else {}
+
+    def health(self):
+        return {"replicas": {k: dict(v) for k, v in self.replicas.items()},
+                "pending_by_tenant": dict(self.pending),
+                "closed": self.closed}
+
+    def scale_to(self, n):
+        self.calls.append(n)
+        self.target = n
+        return n
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _scaler(router, **kw):
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("cooldown_s", 0.0)
+    return Autoscaler(router, **kw)
+
+
+# ------------------------------------------------------------- hysteresis
+
+
+def test_scale_up_needs_consecutive_overload_ticks():
+    """One pressure sample is noise; up_ticks consecutive samples are a
+    trend. The target moves exactly once, on the up_ticks-th tick."""
+    r = FakeRouter(target=2)
+    a = _scaler(r, max_replicas=4, queue_high=2.0, up_ticks=3)
+    r.set_load(queue_depth=5)
+    assert a.tick()["action"] is None
+    assert a.tick()["action"] is None
+    assert a.tick()["action"] == "up"
+    assert r.calls == [3]
+
+
+def test_scale_down_needs_consecutive_underload_ticks():
+    r = FakeRouter(target=3)
+    a = _scaler(r, max_replicas=4, queue_low=1.0, down_ticks=3)
+    r.set_load(queue_depth=0)
+    assert [a.tick()["action"] for _ in range(3)] == [None, None, "down"]
+    assert r.calls == [2]
+
+
+def test_dead_band_resets_streaks():
+    """A sample between the thresholds restarts BOTH streaks — load
+    oscillating in and out of the overload band never accumulates to an
+    action (the hysteresis contract)."""
+    r = FakeRouter(target=2)
+    a = _scaler(r, max_replicas=4, queue_low=1.0, queue_high=8.0, up_ticks=2)
+    for _ in range(4):
+        r.set_load(queue_depth=20)   # overload: streak 1
+        assert a.tick()["action"] is None
+        r.set_load(queue_depth=4)    # dead band: streak back to 0
+        assert a.tick()["action"] is None
+    assert r.calls == []
+
+
+def test_noisy_p95_does_not_flap():
+    """p95 spiking above the threshold every other tick (queue mid-band)
+    never scales — and neither direction ever fires, so the fleet holds."""
+    r = FakeRouter(target=2)
+    a = _scaler(r, max_replicas=4, queue_low=1.0, queue_high=8.0,
+                p95_high_s=1.0, up_ticks=2, down_ticks=2)
+    for i in range(12):
+        r.set_load(queue_depth=4, p95_s=2.5 if i % 2 else 0.1)
+        a.tick()
+    assert r.calls == []
+
+
+def test_sustained_p95_scales_up():
+    """The same spike SUSTAINED is a real signal — p95 pressure alone
+    (queue idle) drives a scale-up."""
+    r = FakeRouter(target=2)
+    a = _scaler(r, max_replicas=4, queue_high=100.0, p95_high_s=1.0,
+                up_ticks=2)
+    r.set_load(queue_depth=0, p95_s=2.5)
+    assert [a.tick()["action"] for _ in range(2)] == [None, "up"]
+
+
+# ---------------------------------------------------------------- cooldown
+
+
+def test_cooldown_blocks_consecutive_actions():
+    clock = FakeClock()
+    r = FakeRouter(target=1)
+    a = _scaler(r, max_replicas=5, queue_high=1.0, up_ticks=1,
+                cooldown_s=100.0, clock=clock)
+    r.set_load(queue_depth=10)
+    assert a.tick()["action"] == "up"
+    for clock.t in (1.0, 10.0, 99.0):
+        assert a.tick()["action"] is None, "action inside the cooldown"
+    clock.t = 150.0
+    assert a.tick()["action"] == "up"
+    assert r.calls == [2, 3]
+
+
+# ------------------------------------------------------------------ bounds
+
+
+def test_max_replicas_caps_scale_up():
+    r = FakeRouter(target=2)
+    a = _scaler(r, max_replicas=2, queue_high=1.0, up_ticks=1)
+    r.set_load(queue_depth=50)
+    for _ in range(5):
+        assert a.tick()["action"] is None
+    assert r.calls == []
+
+
+def test_warm_pool_raises_the_scale_down_floor():
+    """min_replicas=1 + warm_pool=1 → the fleet never drops below 2: the
+    spare is the seconds-not-minutes replacement capacity."""
+    r = FakeRouter(target=3)
+    a = _scaler(r, min_replicas=1, max_replicas=4, warm_pool=1,
+                down_ticks=1, queue_low=1.0)
+    assert a.floor == 2
+    r.set_load(queue_depth=0)
+    assert a.tick()["action"] == "down"       # 3 → 2
+    for _ in range(5):
+        assert a.tick()["action"] is None     # 2 == floor: hold
+    assert r.calls == [2]
+
+
+def test_validation():
+    r = FakeRouter()
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(r, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        Autoscaler(r, min_replicas=2, max_replicas=2, warm_pool=1)
+    with pytest.raises(ValueError, match="queue_low"):
+        Autoscaler(r, queue_low=5.0, queue_high=1.0)
+
+
+def test_closed_router_never_scales():
+    r = FakeRouter(target=2)
+    r.closed = True
+    a = _scaler(r, queue_high=1.0, up_ticks=1)
+    r.set_load(queue_depth=50)
+    assert a.tick()["action"] is None
+    assert r.calls == []
+
+
+# ----------------------------------------------------------------- signals
+
+
+def test_read_signals_normalizes_per_ready_replica():
+    r = FakeRouter(target=2)
+    r.replicas["r0"].update(queue_depth=3, open_tickets=1,
+                            latency_p95_s=0.2)
+    r.replicas["r1"].update(queue_depth=5, latency_p95_s=0.8)
+    r.replicas["r2"] = {"state": "closed", "queue_depth": 99,
+                        "latency_p95_s": 9.9}  # dead: excluded
+    r.pending = {"default": 7}
+    a = _scaler(r)
+    sig = a.read_signals()
+    assert sig["ready"] == 2
+    assert sig["queued"] == 3 + 1 + 5 + 7
+    assert sig["queued_per_replica"] == pytest.approx(8.0)
+    assert sig["p95_s"] == pytest.approx(0.8)
+
+
+def test_start_asserts_warm_pool_floor_then_stops():
+    r = FakeRouter(target=1)
+    a = _scaler(r, min_replicas=1, max_replicas=4, warm_pool=2,
+                interval_s=0.01)
+    a.start()
+    try:
+        assert r.calls[:1] == [3]  # floor asserted immediately, not on load
+    finally:
+        a.stop()
+
+
+# ------------------------------------------------- Router.scale_to units
+
+
+class StubReplica(fleet.ReplicaHandle):
+    """Health-programmable replica (same shape as test_fleet's)."""
+
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.state = fleet.NEW
+        self.drained = False
+        self.h = {"stalled": False, "closed": False, "quarantined": 0,
+                  "queue_depth": 0, "open_tickets": 0,
+                  "last_progress_s": 0.0, "compiles_after_warmup": 0}
+
+    def warm(self, configs, buckets=None, **kwargs):
+        self.state = fleet.READY
+        return {"new_compiles": 0}
+
+    def start(self):
+        pass
+
+    def health(self):
+        return dict(self.h, state=self.state, replica=self.replica_id)
+
+    def drain(self, timeout=None):
+        self.drained = True
+        self.state = fleet.CLOSED
+        return self.health()
+
+    def close(self):
+        self.state = fleet.CLOSED
+
+
+def test_router_scale_to_down_retires_least_loaded():
+    """Scale-down takes the replicas with the least queued work — the busy
+    replica keeps serving, the idle ones drain through the normal path."""
+    reps = {}
+
+    def factory(rid):
+        reps[rid] = StubReplica(rid)
+        return reps[rid]
+
+    router = Router(factory, replicas=3, configs=(), auto_start=False)
+    reps["r1"].h["queue_depth"] = 9  # the busy one
+    assert router.scale_to(1) == 1
+    assert router.target == 1
+    h = router.health()
+    assert h["active_replicas"] == 1 and h["retired_replicas"] == 2
+    assert not reps["r1"].drained, "scale-down retired the BUSY replica"
+    assert reps["r0"].drained and reps["r2"].drained
+
+
+def test_router_scale_up_spawns_on_supervision_tick():
+    reps = {}
+
+    def factory(rid):
+        reps[rid] = StubReplica(rid)
+        return reps[rid]
+
+    router = Router(factory, replicas=1, configs=(), tick_s=0.01)
+    router.scale_to(3)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if router.health()["active_replicas"] == 3:
+            break
+        time.sleep(0.02)
+    h = router.drain(timeout=2)
+    assert h["replicas_spawned"] == 3 and h["retired_replicas"] == 0
+
+
+def test_router_scale_to_clamps_and_ignores_when_closed():
+    reps = {}
+
+    def factory(rid):
+        reps[rid] = StubReplica(rid)
+        return reps[rid]
+
+    router = Router(factory, replicas=2, configs=(), auto_start=False)
+    assert router.scale_to(0) == 1  # floor of one serving replica
+    router.drain(timeout=1)
+    before = router.target
+    assert router.scale_to(5) == before  # closed fleet: target frozen
+
+
+def test_autoscaler_scales_real_router_under_queue_pressure():
+    """End to end over a real Router: synthetic queue-depth pressure on
+    stub replicas drives tick() → scale_to → supervision spawning, and the
+    fleet converges on the new target without flapping past it."""
+    reps = {}
+
+    def factory(rid):
+        reps[rid] = StubReplica(rid)
+        return reps[rid]
+
+    router = Router(factory, replicas=2, configs=(), tick_s=0.01)
+    a = Autoscaler(router, min_replicas=1, max_replicas=3, queue_high=2.0,
+                   up_ticks=2, cooldown_s=0.0, clock=FakeClock())
+    for rep in reps.values():
+        rep.h["queue_depth"] = 10
+    assert a.tick()["action"] is None
+    assert a.tick()["action"] == "up"
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if router.health()["active_replicas"] == 3:
+            break
+        time.sleep(0.02)
+    assert router.health()["active_replicas"] == 3
+    # pressure gone → nothing further happens inside the streak window
+    for rep in reps.values():
+        rep.h["queue_depth"] = 0
+    assert a.tick()["action"] is None
+    h = router.drain(timeout=2)
+    assert h["replicas_spawned"] == 3 and h["retired_replicas"] == 0
