@@ -250,6 +250,7 @@ class RemoteReplica(fleet.ReplicaHandle):
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._next_id = 0                               # guarded-by: _lock
+        self._next_rid = 0                              # guarded-by: _lock
         self._pending: dict = {}                        # guarded-by: _lock
         self._tickets: dict = {}                        # guarded-by: _lock
         self._crashed = False                           # guarded-by: _lock
@@ -286,6 +287,15 @@ class RemoteReplica(fleet.ReplicaHandle):
             return  # frame dropped on the floor — no send, no error
         faults.fire("rpc.latency", tag=tag)
         payload = encode_payload(msg)
+        if len(payload) > MAX_FRAME_BYTES:
+            # reject locally and typed (RemoteRPCError is NOT retryable):
+            # an oversized frame shipped anyway would be killed by the
+            # peer's recv_frame, and a retried/hedged resend would then
+            # serially take down every replica it lands on
+            raise RemoteRPCError(
+                f"replica {self.replica_id}: {method!r} frame of "
+                f"{len(payload)} bytes exceeds "
+                f"MAX_FRAME_BYTES={MAX_FRAME_BYTES}")
         try:
             with self._send_lock:
                 self._conn.sendall(struct.pack(">I", len(payload)) + payload)
@@ -430,6 +440,15 @@ class RemoteReplica(fleet.ReplicaHandle):
             self._conn.close()
         except OSError:
             pass
+        # A crash detected via heartbeat loss can leave the child ALIVE but
+        # wedged, holding the accelerator — a respawned replacement then
+        # cannot acquire the device. Kill it; the _proc_wait_loop thread
+        # (blocked in wait()) reaps the zombie.
+        if self._proc.poll() is None:
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
 
     # ----------------------------------------------------------- lifecycle
 
@@ -475,18 +494,29 @@ class RemoteReplica(fleet.ReplicaHandle):
             params["x_init"] = np.asarray(x_init)
         if mask is not None:
             params["mask"] = np.asarray(mask)
-        result = self._call("submit", params)
+        # The CLIENT allocates the rid and registers the ticket BEFORE the
+        # submit frame leaves, so a fast-resolving request whose done event
+        # races (or beats) the submit response still finds its ticket —
+        # _dispatch drops events for unknown rids, and a dropped done event
+        # would block result() forever on a healthy replica.
         ticket = Ticket(int(n))
         ticket._health_cb = self.health
         with self._lock:
             if self._crashed:
-                resolve_now = True
-            else:
-                resolve_now = False
-                self._tickets[result["rid"]] = ticket
-        if resolve_now:
-            ticket._fail(ReplicaCrashedError(
-                f"replica {self.replica_id} crashed: {self.crash_reason}"))
+                raise ReplicaCrashedError(
+                    f"replica {self.replica_id} crashed: {self.crash_reason}")
+            rid = self._next_rid
+            self._next_rid += 1
+            self._tickets[rid] = ticket
+        params["rid"] = rid
+        try:
+            self._call("submit", params)
+        except Exception:  # noqa: BLE001 — submit never happened server-side
+            # (send failed / deadline / typed rejection): unregister so a
+            # stray late event cannot touch a ticket the caller never got
+            with self._lock:
+                self._tickets.pop(rid, None)
+            raise
         return ticket
 
     def health(self) -> dict:
@@ -503,6 +533,18 @@ class RemoteReplica(fleet.ReplicaHandle):
         this same path."""
         self._draining.set()
         if self.state == fleet.CLOSED:
+            # retirement of a crashed replica must not leak the child:
+            # _on_crash already sent SIGKILL for the wedged-but-alive case,
+            # but make retirement itself the backstop before returning
+            if self._proc.poll() is None:
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            try:
+                self._proc.wait(timeout=self.rpc_timeout_s)
+            except subprocess.TimeoutExpired:
+                pass
             return {"closed": True, "crashed": True,
                     "reason": self.crash_reason}
         self._set_state(fleet.DRAINING)
@@ -610,8 +652,26 @@ def remote_factory(spec: dict, *, env: Optional[dict] = None,
                 f"{spawn_timeout_s}s of spawn") from None
         finally:
             listener.close()
+        # The hello read spends what is LEFT of the spawn budget — a child
+        # that connects but wedges before its hello (hung device init) must
+        # not block the factory, and through it fleet-wide supervision,
+        # forever. Only a validated hello earns a deadline-free socket.
+        remaining = spawn_timeout_s - (time.perf_counter() - t0)
+        conn.settimeout(max(1.0, remaining))
+        try:
+            hello = recv_frame(conn)
+        except Exception as exc:  # noqa: BLE001 — timeout, EOF, garbage:
+            # the child never completed its half of the handshake
+            proc.kill()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ReplicaUnreachableError(
+                f"replica {replica_id}: connected but sent no valid hello "
+                f"within the {spawn_timeout_s}s spawn budget ({exc})"
+            ) from exc
         conn.settimeout(None)
-        hello = recv_frame(conn)
         if hello.get("event") != "hello":
             proc.kill()
             raise RemoteRPCError(

@@ -191,21 +191,58 @@ class ReplicaServer:
         self._replica = replica
         self._replica_id = replica_id
         self._send_lock = threading.Lock()
-        self._lock = threading.Lock()
-        self._next_rid = 0                              # guarded-by: _lock
 
     def send(self, msg: dict) -> None:
         payload = remote.encode_payload(msg)
+        if len(payload) > remote.MAX_FRAME_BYTES:
+            # raise locally and typed — shipping the frame anyway would be
+            # answered by the parent's recv_frame killing the connection
+            raise RemoteRPCError(
+                f"outbound frame of {len(payload)} bytes exceeds "
+                f"MAX_FRAME_BYTES={remote.MAX_FRAME_BYTES}")
         try:
             with self._send_lock:
                 self._conn.sendall(struct.pack(">I", len(payload)) + payload)
         except OSError:
             pass  # parent gone; the reader loop will see EOF and exit
 
+    def _recv_request(self) -> dict:
+        """recv_frame, except a protocol violation is NOT treated as
+        parent-gone: an over-limit frame is drained (its length prefix says
+        exactly how many bytes to discard, so the stream stays in sync) and
+        answered with a typed error event — one bad request must not kill
+        the replica, or a failover would replay it onto every survivor."""
+        while True:
+            (length,) = struct.unpack(
+                ">I", remote._recv_exact(self._conn, 4))
+            if length <= remote.MAX_FRAME_BYTES:
+                return remote.decode_payload(
+                    remote._recv_exact(self._conn, length))
+            remaining = length
+            while remaining:
+                chunk = self._conn.recv(min(remaining, 1 << 20))
+                if not chunk:
+                    raise ConnectionError("connection closed mid-frame")
+                remaining -= len(chunk)
+            self.send({"event": "protocol_error",
+                       "error": encode_exception(RemoteRPCError(
+                           f"inbound frame of {length} bytes exceeds "
+                           f"MAX_FRAME_BYTES={remote.MAX_FRAME_BYTES}"))})
+
     def serve(self) -> None:
         while True:
             try:
-                msg = remote.recv_frame(self._conn)
+                msg = self._recv_request()
+            except (RemoteRPCError, ValueError, KeyError, TypeError) as exc:
+                # garbage INSIDE a fully consumed frame (bad JSON, bogus
+                # dtype, truncated buffers): the stream is still framed —
+                # answer typed and keep serving
+                try:
+                    self.send({"event": "protocol_error",
+                               "error": encode_exception(exc)})
+                except RemoteRPCError:
+                    pass
+                continue
             except Exception:  # noqa: BLE001 — EOF/reset: parent is gone,
                 break          # so is our reason to exist
             try:
@@ -248,9 +285,23 @@ class ReplicaServer:
             self.send({"id": call_id, "ok": False,
                        "error": encode_exception(exc)})
             return
-        self.send({"id": call_id, "ok": True, "result": result})
+        self._answer(call_id, result)
+
+    def _answer(self, call_id, result) -> None:
+        try:
+            self.send({"id": call_id, "ok": True, "result": result})
+        except RemoteRPCError as exc:  # response too big for one frame:
+            # the caller still gets an answer, just a typed failure
+            self.send({"id": call_id, "ok": False,
+                       "error": encode_exception(exc)})
 
     def _submit(self, params: dict) -> dict:
+        # the CLIENT owns rid allocation: it registered its ticket under
+        # this rid before the submit frame left, so our ticket/preview
+        # events can never race ahead of the registration (remote.py)
+        rid = params.get("rid")
+        if rid is None:
+            raise RemoteRPCError("submit without a client-allocated rid")
         cfg = params.get("config")
         if isinstance(cfg, dict):
             cfg = SamplerConfig(**cfg)
@@ -260,9 +311,6 @@ class ReplicaServer:
             seed=params.get("seed"), n=n, x_init=params.get("x_init"),
             mask=params.get("mask"), config=cfg,
             deadline_s=params.get("deadline_s"), **kwargs)
-        with self._lock:
-            rid = self._next_rid
-            self._next_rid += 1
         ticket.add_preview_callback(
             lambda step, frames, _rid=rid: self.send(
                 {"event": "preview", "rid": _rid, "step": int(step),
@@ -273,12 +321,15 @@ class ReplicaServer:
 
     def _push_result(self, rid: int, ticket) -> None:
         exc = ticket.exception(timeout=0)
-        if exc is not None:
-            self.send({"event": "ticket", "rid": rid, "status": "error",
-                       "error": encode_exception(exc)})
-        else:
-            self.send({"event": "ticket", "rid": rid, "status": "done",
-                       "result": ticket.result(timeout=0)})
+        if exc is None:
+            try:
+                self.send({"event": "ticket", "rid": rid, "status": "done",
+                           "result": ticket.result(timeout=0)})
+                return
+            except RemoteRPCError as send_exc:  # result too big for one
+                exc = send_exc                  # frame: fail the ticket typed
+        self.send({"event": "ticket", "rid": rid, "status": "error",
+                   "error": encode_exception(exc)})
 
     def _slow(self, call_id, method: str, params: dict) -> None:
         """warm/drain/close run off the reader thread (they block for
@@ -303,7 +354,7 @@ class ReplicaServer:
             self.send({"id": call_id, "ok": False,
                        "error": encode_exception(exc)})
             return
-        self.send({"id": call_id, "ok": True, "result": result})
+        self._answer(call_id, result)
 
 
 def build_replica(replica_id: str, spec: dict):

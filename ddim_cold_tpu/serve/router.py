@@ -303,12 +303,13 @@ class Router:
             if self._closed:
                 return self._target
             self._target = n
-            excess = len(self._replicas) - n
+            # excess counts READY replicas only: a crashed/DRAINING handle
+            # still in the dict is already leaving (supervision retires it)
+            # and must not cost an extra ready victim its place
+            ready = [(rid, rep) for rid, rep in self._replicas.items()
+                     if rep.state == fleet.READY]
+            excess = len(ready) - n
         if excess > 0:
-            victims = []
-            with self._lock:
-                ready = [(rid, rep) for rid, rep in self._replicas.items()
-                         if rep.state == fleet.READY]
             scored = []
             for rid, rep in ready:
                 try:

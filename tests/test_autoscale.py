@@ -262,6 +262,25 @@ def test_router_scale_to_down_retires_least_loaded():
     assert reps["r0"].drained and reps["r2"].drained
 
 
+def test_router_scale_to_excess_counts_ready_replicas_only():
+    """Scale-down while one replica is crashed (CLOSED in the dict, not
+    yet retired by supervision) must not take extra READY capacity: excess
+    is measured against READY replicas, the dead one is already leaving."""
+    reps = {}
+
+    def factory(rid):
+        reps[rid] = StubReplica(rid)
+        return reps[rid]
+
+    router = Router(factory, replicas=3, configs=(), auto_start=False)
+    reps["r0"].state = fleet.CLOSED  # crashed behind the router's back
+    assert router.scale_to(2) == 2
+    ready = [r for r in reps.values() if r.state == fleet.READY]
+    assert len(ready) == 2, \
+        "scale-down retired READY capacity the dead replica already freed"
+    assert not any(r.drained for r in ready)
+
+
 def test_router_scale_up_spawns_on_supervision_tick():
     reps = {}
 

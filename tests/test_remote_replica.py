@@ -10,6 +10,9 @@ real SIGKILL inside the child, ``replica.hang`` wedges its reader thread
 """
 
 import socket
+import struct
+import subprocess
+import threading
 import time
 
 import numpy as np
@@ -18,7 +21,7 @@ import pytest
 from ddim_cold_tpu.serve import fleet, remote, replica_main
 from ddim_cold_tpu.serve.batching import SamplerConfig
 from ddim_cold_tpu.serve.errors import (DeadlineExceeded, EngineClosedError,
-                                        ReplicaCrashedError,
+                                        RemoteRPCError, ReplicaCrashedError,
                                         ReplicaUnreachableError,
                                         RequestFailedError, decode_exception,
                                         encode_exception)
@@ -214,6 +217,13 @@ def test_heartbeat_loss_retires_hung_replica(reaper):
         rep.submit(seed=0, n=1)  # first work frame trips the wedge
     assert rep.state == fleet.CLOSED
     assert "heartbeat lost" in rep.crash_reason
+    # the wedged child is ALIVE when the heartbeat budget empties — crash
+    # handling must kill it, not just close the socket (a leaked child
+    # would hold the accelerator against the respawned replacement)
+    assert _poll(lambda: rep._proc.poll() is not None), \
+        "heartbeat-loss crash leaked a live child process"
+    rep.drain(timeout=5)  # retiring the corpse reaps it
+    assert rep._proc.poll() is not None
 
 
 def test_deadline_enforced_across_the_rpc_boundary(reaper):
@@ -238,6 +248,111 @@ def test_rpc_drop_turns_into_unreachable_at_the_deadline(reaper):
             rep.health()
     assert rep.health()["state"] == fleet.READY  # drop was the fault, not us
     rep.drain(timeout=10)
+
+
+# ---------------------------------------------- protocol races and limits
+
+
+class _FakeProc:
+    """Popen lookalike for driving a RemoteReplica against a socketpair."""
+
+    def __init__(self):
+        self._dead = threading.Event()
+
+    def wait(self, timeout=None):
+        if not self._dead.wait(timeout):
+            raise subprocess.TimeoutExpired("fake-replica", timeout)
+        return 0
+
+    def poll(self):
+        return 0 if self._dead.is_set() else None
+
+    def kill(self):
+        self._dead.set()
+
+
+def test_done_event_racing_ahead_of_submit_response_still_resolves():
+    """The server's ticket done event can hit the wire BEFORE the submit
+    RPC response (add_done_callback fires from the resolver thread for a
+    fast request). The client registers the rid before the submit frame
+    leaves, so the early event finds its ticket — an unknown-rid drop here
+    would leave result() blocking forever on a healthy replica."""
+    parent, child = socket.socketpair()
+    proc = _FakeProc()
+    rep = remote.RemoteReplica(parent, proc, replica_id="race",
+                               heartbeat_s=60.0)
+    try:
+        rep.state = fleet.READY  # the fake server has no warm step
+        rows = replica_main.stub_rows(3, 2, STUB_SHAPE)
+
+        def server():
+            msg = remote.recv_frame(child)
+            rid = msg["params"]["rid"]
+            # the racy interleaving, made deterministic: done event first,
+            # submit response second
+            remote.send_frame(child, {"event": "ticket", "rid": rid,
+                                      "status": "done", "result": rows})
+            remote.send_frame(child, {"id": msg["id"], "ok": True,
+                                      "result": {"rid": rid, "n": 2}})
+
+        th = threading.Thread(target=server, daemon=True)
+        th.start()
+        t = rep.submit(seed=3, n=2)
+        np.testing.assert_array_equal(t.result(timeout=10), rows)
+        th.join(5)
+    finally:
+        proc.kill()
+        parent.close()
+        child.close()
+
+
+def test_oversized_submit_rejected_locally_replica_survives(
+        reaper, monkeypatch):
+    """An over-MAX_FRAME_BYTES submit raises typed at the CLIENT send site
+    (RemoteRPCError — not retryable, so a hedge cannot replay it), and the
+    replica it never reached keeps serving."""
+    rep = _spawn(reaper, spec={"stub": {"shape": list(STUB_SHAPE)}})
+    rep.warm([CFG], buckets=(4,), persistent_cache=False)
+    rep.start()
+    monkeypatch.setattr(remote, "MAX_FRAME_BYTES", 4096)
+    with pytest.raises(RemoteRPCError, match="MAX_FRAME_BYTES"):
+        rep.submit(seed=0, n=1,
+                   x_init=np.zeros((1, 64, 64, 3), np.float32))
+    monkeypatch.setattr(remote, "MAX_FRAME_BYTES", 1 << 30)
+    assert rep.health()["state"] == fleet.READY
+    t = rep.submit(seed=5, n=2)
+    np.testing.assert_array_equal(t.result(timeout=15),
+                                  replica_main.stub_rows(5, 2, STUB_SHAPE))
+    rep.drain(timeout=10)
+
+
+def test_server_drains_oversized_frame_and_keeps_serving(monkeypatch):
+    """An over-limit INBOUND frame is not parent-gone: the server discards
+    exactly the declared payload (stream stays framed), answers with a
+    typed protocol_error event, and serves the next request — one bad
+    frame must not os._exit a replica."""
+    parent, child = socket.socketpair()
+    try:
+        srv = replica_main.ReplicaServer(child, replica=None,
+                                         replica_id="lim")
+        monkeypatch.setattr(remote, "MAX_FRAME_BYTES", 1024)
+        parent.sendall(struct.pack(">I", 2048) + b"\x00" * 2048)
+        remote.send_frame(parent, {"id": 2, "method": "ping", "params": {}})
+
+        def server_turn():
+            srv.handle(srv._recv_request())
+
+        th = threading.Thread(target=server_turn, daemon=True)
+        th.start()
+        err_evt = remote.recv_frame(parent)
+        assert err_evt["event"] == "protocol_error"
+        assert "MAX_FRAME_BYTES" in err_evt["error"]["message"]
+        pong = remote.recv_frame(parent)
+        assert pong["id"] == 2 and pong["ok"]
+        th.join(5)
+    finally:
+        parent.close()
+        child.close()
 
 
 # ------------------------------------------------------------ fleet failover
