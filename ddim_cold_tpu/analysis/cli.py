@@ -1,9 +1,10 @@
 """graftcheck CLI — ``python -m ddim_cold_tpu.analysis`` / ``graftcheck``.
 
-Runs the seven layers (AST lint, thread-safety lockset analysis, jaxpr
+Runs the nine layers (AST lint, thread-safety lockset analysis, jaxpr
 entry checks + serve-signature sweep, Pallas kernel-geometry verification,
 peak-HBM budget analysis, collective-order proofs over the sweep's traces,
-sharding coverage), subtracts the reviewed ``--baseline`` allowlist,
+sharding coverage, RPC protocol proofs, SamplerConfig lattice coverage),
+subtracts the reviewed ``--baseline`` allowlist,
 prints the rest and exits nonzero if any remain.
 ``--fix-baseline`` regenerates the allowlist deterministically instead
 (sorted, deduped) so its diffs review cleanly; combined with ``--only`` it
@@ -22,6 +23,16 @@ the jaxpr layer's world-A sweep feeds collective/kernels/memory, its
 build/train entry traces feed kernels — each program is traced once no
 matter how many layers walk it. The 200px kernel entries
 (``entries.kernel_entries``) are traced once and shared by kernels+memory.
+
+Layers run CONCURRENTLY where they can: the pure host-side layers (ast,
+threads, protocol, config) fan out onto worker threads while the
+jax-touching chain — jaxpr/collective/kernels/memory serialized through
+the one shared trace stash, plus sharding — runs on the calling thread.
+The config layer qualifies as host-side because its lattice enumeration
+never traces: its X001/X003 sweep witnesses come from
+``entries.serve_sweep()``, which only CONSTRUCTS configs. Every layer
+returns its own findings list, so the fan-out needs no locking; the final
+``sorted()`` merge keeps output order identical to a serial run.
 """
 
 from __future__ import annotations
@@ -29,16 +40,18 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from concurrent.futures import ThreadPoolExecutor
 
 from ddim_cold_tpu.analysis import findings as F
 
 LAYERS = ("ast", "jaxpr", "kernels", "memory", "sharding", "threads",
-          "collective")
+          "collective", "protocol", "config")
 
 #: rule-family letters accepted by --only as layer aliases (--only T,C)
 _ONLY_ALIASES = {"a": "ast", "j": "jaxpr", "s": "sharding",
                  "t": "threads", "c": "collective",
-                 "p": "kernels", "m": "memory"}
+                 "p": "kernels", "m": "memory",
+                 "r": "protocol", "x": "config"}
 
 
 def parse_only(values) -> tuple:
@@ -67,18 +80,42 @@ def repo_root() -> str:
     return os.path.dirname(pkg)
 
 
-def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
-            ) -> list[F.Finding]:
-    """All findings from the requested layers, sorted for stable output."""
-    out: list[F.Finding] = []
-    if "ast" in only:
+def _host_layer(layer: str, root: str):
+    """One pure host-side layer as a thunk result — no jax tracing, no
+    shared state, safe on a worker thread."""
+    if layer == "ast":
         from ddim_cold_tpu.analysis import ast_checks
 
-        out += ast_checks.lint_tree(root)
-    if "threads" in only:
+        return ast_checks.lint_tree(root)
+    if layer == "threads":
         from ddim_cold_tpu.analysis import thread_checks
 
-        out += thread_checks.lint_tree(root)
+        return thread_checks.lint_tree(root)
+    if layer == "protocol":
+        from ddim_cold_tpu.analysis import protocol_checks
+
+        return protocol_checks.run_protocol_checks(root)
+    from ddim_cold_tpu.analysis import config_checks
+
+    return config_checks.run_config_checks(root)
+
+
+#: layers _host_layer serves — fanned out on worker threads by collect()
+_HOST_LAYERS = ("ast", "threads", "protocol", "config")
+
+
+def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
+            ) -> list[F.Finding]:
+    """All findings from the requested layers, sorted for stable output.
+
+    The host-side layers run on a thread pool overlapping the jax chain
+    below; futures are collected at the end so a worker exception
+    propagates exactly like a serial failure would.
+    """
+    out: list[F.Finding] = []
+    host = [layer for layer in _HOST_LAYERS if layer in only]
+    pool = ThreadPoolExecutor(max_workers=len(host)) if host else None
+    futures = [pool.submit(_host_layer, layer, root) for layer in host]
     # the collective/kernels/memory layers consume the jaxpr layer's sweep
     # traces when they run together (one sweep trace no matter how many
     # layers walk it); the kernels layer additionally rides the jaxpr
@@ -127,6 +164,12 @@ def collect(root: str, only=LAYERS, max_const_bytes: int = 1 << 20
         from ddim_cold_tpu.analysis import sharding_checks
 
         out += sharding_checks.run_sharding_checks()
+    if pool is not None:
+        try:
+            for future in futures:
+                out += future.result()
+        finally:
+            pool.shutdown()
     return sorted(out)
 
 

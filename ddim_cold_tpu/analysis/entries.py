@@ -41,16 +41,28 @@ N = 4      # batch rows for the non-serve entries
 def serve_sweep():
     from ddim_cold_tpu.serve.batching import SamplerConfig
 
+    # Bucket policy: the bucket axis enters every program the same way (a
+    # batch-dim substitution), so two-bucket stability/distinctness is
+    # proven by ONE (4, 8) witness per scan family — ddim, cold, inpaint,
+    # the sequence variant, fewstep (plus the warmed pairs tests pin).
+    # Every other entry traces at (4,) only: each extra bucket is a full
+    # extra trace in BOTH J006 worlds, and the single-bucket entries'
+    # program structure is already bucket-proven by their family witness.
     sweep = [
         ("ddim_k500", SamplerConfig(k=K), (4, 8)),
-        ("ddim_k500_ci2", SamplerConfig(k=K, cache_interval=2), (4, 8)),
+        ("ddim_k500_ci2", SamplerConfig(k=K, cache_interval=2), (4,)),
+        # cache_mode="full" (whole-trunk reuse steps) had NO sweep entry
+        # until the X001 sweep-completeness rule flagged it: a legal,
+        # serveable mode with zero J006 coverage
+        ("ddim_k500_ci2_full",
+         SamplerConfig(k=K, cache_interval=2, cache_mode="full"), (4,)),
         # adaptive/token caching (ISSUE 8). ONE adaptive threshold value in
         # the whole sweep: signature_hash is constant-blind, so a second
         # threshold would collide by design. Distinct token_k values ARE
         # structurally distinct (the gathered (B, k, E) aval differs).
         ("ddim_k500_adapt",
          SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
-                       cache_threshold=0.05), (4, 8)),
+                       cache_threshold=0.05), (4,)),
         ("ddim_k500_adapt_qxla",
          SamplerConfig(k=K, cache_interval=2, cache_mode="adaptive",
                        cache_threshold=0.05, quant="xla"), (4,)),
@@ -64,7 +76,7 @@ def serve_sweep():
                        cache_threshold=0.05, telemetry=True), (4,)),
         ("ddim_k500_tok3",
          SamplerConfig(k=K, cache_interval=2, cache_mode="token",
-                       cache_tokens=3), (4, 8)),
+                       cache_tokens=3), (4,)),
         ("ddim_k500_tok2",
          SamplerConfig(k=K, cache_interval=2, cache_mode="token",
                        cache_tokens=2), (4,)),
@@ -72,12 +84,12 @@ def serve_sweep():
          SamplerConfig(sampler="cold", levels=4, cache_interval=2,
                        cache_mode="adaptive", cache_threshold=0.05), (4,)),
         ("inpaint_k500_ci2",
-         SamplerConfig(task="inpaint", k=K, cache_interval=2), (4, 8)),
+         SamplerConfig(task="inpaint", k=K, cache_interval=2), (4,)),
         ("inpaint_k500_tok3",
          SamplerConfig(task="inpaint", k=K, cache_interval=2,
                        cache_mode="token", cache_tokens=3), (4,)),
         ("cold_l4", SamplerConfig(sampler="cold", levels=4), (4, 8)),
-        ("ddim_k500_t999", SamplerConfig(k=K, t_start=999), (4, 8)),
+        ("ddim_k500_t999", SamplerConfig(k=K, t_start=999), (4,)),
         ("ddim_k500_qxla", SamplerConfig(k=K, quant="xla"), (4,)),
         # editing workloads (ddim_cold_tpu/workloads) + preview variants:
         # trip counts at K=500/T=2000 — t=None→4, t1200→3, t999→2, t400→1
@@ -89,16 +101,25 @@ def serve_sweep():
          SamplerConfig(task="inpaint", k=K, quant="xla"), (4,)),
         ("inpaint_k500_pv2",
          SamplerConfig(task="inpaint", k=K, preview_every=2), (4,)),
+        ("inpaint_k500_ci2_pv2",
+         SamplerConfig(task="inpaint", k=K, cache_interval=2,
+                       preview_every=2), (4,)),
         ("superres_l3",
-         SamplerConfig(task="superres", sampler="cold", levels=3), (4, 8)),
+         SamplerConfig(task="superres", sampler="cold", levels=3), (4,)),
         ("superres_l3_ci2",
          SamplerConfig(task="superres", sampler="cold", levels=3,
                        cache_interval=2), (4,)),
+        # cached+preview crossings (X001): each scan family's cached
+        # SEQUENCE variant is a distinct program (_*_cached_seq) the sweep
+        # previously never traced — cold here, inpaint and fewstep below
+        ("superres_l3_ci2_pv1",
+         SamplerConfig(task="superres", sampler="cold", levels=3,
+                       cache_interval=2, preview_every=1), (4,)),
         ("superres_l3_pv1",
          SamplerConfig(task="superres", sampler="cold", levels=3,
                        preview_every=1), (4,)),
         ("draft_k500_t1200",
-         SamplerConfig(task="draft", k=K, t_start=1200), (4, 8)),
+         SamplerConfig(task="draft", k=K, t_start=1200), (4,)),
         ("draft_k500_t1200_ci2",
          SamplerConfig(task="draft", k=K, t_start=1200, cache_interval=2),
          (4,)),
@@ -112,9 +133,11 @@ def serve_sweep():
         # different params (warmup dedup relies on exactly that), so a
         # student entry would be a deliberate J006 collision.
         ("ddim_fs1", SamplerConfig(steps=1), (4, 8)),
-        ("ddim_fs2", SamplerConfig(steps=2), (4, 8)),
+        ("ddim_fs2", SamplerConfig(steps=2), (4,)),
         ("ddim_fs4", SamplerConfig(steps=4), (4,)),
         ("ddim_fs4_ci2", SamplerConfig(steps=4, cache_interval=2), (4,)),
+        ("ddim_fs4_ci2_pv1",
+         SamplerConfig(steps=4, cache_interval=2, preview_every=1), (4,)),
         ("ddim_fs2_pv1", SamplerConfig(steps=2, preview_every=1), (4,)),
         ("ddim_fs1_qxla", SamplerConfig(steps=1, quant="xla"), (4,)),
     ]

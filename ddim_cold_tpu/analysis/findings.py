@@ -95,6 +95,34 @@ RULES = {
     "GRAFT-M002": "bucket/sequence padding inflates a traced program's "
                   "resident token axis beyond the threshold over the "
                   "logical payload",
+    "GRAFT-R001": "RPC frame-kind parity violation: a wire method/event "
+                  "without a table entry, a table entry without a site, a "
+                  "client/server table mismatch, or a health field missing "
+                  "from a backend the fleet control plane reads",
+    "GRAFT-R002": "exception-serialization hole: a serve/errors.py type "
+                  "outside the wire codec (or failing round-trip), or a "
+                  "protocol-module raise of an unregistered type that "
+                  "would degrade to RequestFailedError on the wire",
+    "GRAFT-R003": "rid lifecycle inversion: the client ticket registration "
+                  "does not dominate the submit send — a done event racing "
+                  "the response finds no ticket (the PR-19 race)",
+    "GRAFT-R004": "unbounded read/send on the RPC wire: a length-prefixed "
+                  "read or frame send without a MAX_FRAME_BYTES check, an "
+                  "uncapped recv chunk, or a socket going deadline-free "
+                  "before its validated handshake read",
+    "GRAFT-R005": "wire chaos-site gap: the frame-send/dispatch choke "
+                  "points don't fire their registered rpc.*/replica.* "
+                  "fault sites (or the sites aren't registered at all)",
+    "GRAFT-X001": "legal SamplerConfig program class with no serve-sweep "
+                  "witness — it would reach production untraced and "
+                  "unwarmed (the J006 completeness converse)",
+    "GRAFT-X002": "config validation inconsistency: construction-time and "
+                  "program-build gates disagree, a distill-producible "
+                  "student count is unservable, or a frozen config is "
+                  "mutated past the gate via object.__setattr__",
+    "GRAFT-X003": "warm-set/bench config outside the legal lattice (or "
+                  "warmed without a sweep witness) — serving would warm or "
+                  "benchmark a program the lattice proofs never saw",
 }
 
 #: rule-family letter (GRAFT-<X>NNN) → the CLI layer that emits it. The
@@ -102,7 +130,8 @@ RULES = {
 #: layer run is authoritative for.
 RULE_LAYERS = {"A": "ast", "J": "jaxpr", "S": "sharding",
                "T": "threads", "C": "collective",
-               "P": "kernels", "M": "memory"}
+               "P": "kernels", "M": "memory",
+               "R": "protocol", "X": "config"}
 
 
 def rule_layer(rule: str) -> str:

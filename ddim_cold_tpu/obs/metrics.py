@@ -88,6 +88,8 @@ METRICS = (
     ("remote.crashes", "counter",
      "replica process deaths detected (exit or heartbeat loss)"),
     ("remote.heartbeat_misses", "counter", "heartbeat pings that timed out"),
+    ("remote.protocol_errors", "counter",
+     "server-pushed protocol_error events (a frame the replica refused)"),
     # -- autoscaler (serve/autoscale.py) ----------------------------------
     ("autoscale.ticks", "counter", "control-loop decisions evaluated"),
     ("autoscale.scale_ups", "counter", "target increments issued"),

@@ -72,6 +72,20 @@ from ddim_cold_tpu.utils import faults
 #: 4 GiB allocation request)
 MAX_FRAME_BYTES = 1 << 30
 
+#: client→server RPC method kinds on the wire — one entry per ``_call``
+#: method literal below. graftcheck R001 proves this table matches the
+#: actual call sites AND stays set-equal to the server's
+#: ``replica_main.SERVER_METHODS`` (a method sent with no handler, or a
+#: handler no client can reach, is a protocol-drift bug).
+CLIENT_METHODS = ("ping", "health", "start", "submit", "warm", "drain",
+                  "close")
+
+#: server-push event kinds the client has a dispatch arm for (``_dispatch``
+#: plus the factory's hello validation). R001 proves every event the server
+#: can emit (``replica_main.SERVER_EVENTS``) lands in one of these arms —
+#: an unmatched event kind would be silently dropped on the floor.
+CLIENT_EVENT_ARMS = ("hello", "ticket", "preview", "protocol_error")
+
 
 # ---------------------------------------------------------------------------
 # framing
@@ -247,6 +261,11 @@ class RemoteReplica(fleet.ReplicaHandle):
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.warm_timeout_s = float(warm_timeout_s)
         self.crash_reason: Optional[str] = None
+        #: last typed error the server pushed for a frame it refused to
+        #: decode (over-limit or garbage) — there is no call id to fail, so
+        #: the breadcrumb lands here and the in-flight call's own deadline
+        #: surfaces the failure
+        self.last_protocol_error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._next_id = 0                               # guarded-by: _lock
@@ -386,6 +405,15 @@ class RemoteReplica(fleet.ReplicaHandle):
             rows = msg.get("rows")
             if ticket is not None and isinstance(rows, np.ndarray):
                 ticket._preview(int(msg.get("step", 0)), 0, ticket.n, rows)
+        elif event == "protocol_error":
+            # the server refused one of our frames (over-limit, bad JSON)
+            # and could not attribute it to a call id — record the typed
+            # error so the inevitable per-call deadline has a cause to
+            # point at, and count it (a drift here means frame-limit or
+            # codec skew between the two processes)
+            self.metrics.inc("remote.protocol_errors")
+            self.last_protocol_error = decode_exception(
+                msg.get("error") or {})
 
     def _heartbeat_loop(self) -> None:
         misses = 0

@@ -55,6 +55,17 @@ from ddim_cold_tpu.serve.errors import (DeadlineExceeded, EngineClosedError,
                                         encode_exception)
 from ddim_cold_tpu.utils import faults
 
+#: RPC methods this server answers — one entry per ``handle`` dispatch arm.
+#: graftcheck R001 proves the table matches the arms AND stays set-equal to
+#: the client's ``remote.CLIENT_METHODS``.
+SERVER_METHODS = ("ping", "health", "start", "submit", "warm", "drain",
+                  "close")
+
+#: server-initiated event kinds this process may push — one entry per
+#: ``send({"event": ...})`` literal. R001 proves every one has a client
+#: dispatch arm (``remote.CLIENT_EVENT_ARMS``).
+SERVER_EVENTS = ("hello", "ticket", "preview", "protocol_error")
+
 
 def stub_rows(seed, n: int, shape: tuple) -> np.ndarray:
     """The stub's entire 'sampler': rows are a pure function of (seed, n)
@@ -150,10 +161,19 @@ class StubEngine:
         return report
 
     def health(self) -> dict:
+        # field parity with Engine.health() for every key the router and
+        # autoscaler read (graftcheck R001): the stub resolves work
+        # synchronously in run(), so the live-load fields are honestly zero
+        # — but they must EXIST, or the RPC protocol tests would silently
+        # exercise a health contract the real engine doesn't have
         with self._lock:
             depth = len(self._queue)
             closed = self._closed
         return {"replica": self.replica_id, "queue_depth": depth,
+                "open_tickets": 0,
+                "latency_p50_s": 0.0, "latency_p95_s": 0.0,
+                "latency_p99_s": 0.0,
+                "last_progress_s": 0.0, "quarantined": 0,
                 "closed": closed, "stalled": False, "running": not closed,
                 "compiles": self.stats["compiles"],
                 "max_queue": self.max_queue}

@@ -776,8 +776,9 @@ def test_cli_only_accepts_family_letters_and_names():
     assert cli.parse_only(["P,M"]) == ("kernels", "memory")
     assert cli.parse_only(["ast", "j"]) == ("ast", "jaxpr")
     assert cli.parse_only(["threads,threads"]) == ("threads",)
+    assert cli.parse_only(["R,X"]) == ("protocol", "config")
     with pytest.raises(Exception):
-        cli.parse_only(["x"])
+        cli.parse_only(["z"])
 
 
 def test_rule_table_covers_all_emitted_rules():
@@ -788,7 +789,10 @@ def test_rule_table_covers_all_emitted_rules():
         "GRAFT-T001", "GRAFT-T002", "GRAFT-T003", "GRAFT-T004", "GRAFT-T005",
         "GRAFT-C001", "GRAFT-C002",
         "GRAFT-P001", "GRAFT-P002", "GRAFT-P003",
-        "GRAFT-M001", "GRAFT-M002"}
+        "GRAFT-M001", "GRAFT-M002",
+        "GRAFT-R001", "GRAFT-R002", "GRAFT-R003", "GRAFT-R004",
+        "GRAFT-R005",
+        "GRAFT-X001", "GRAFT-X002", "GRAFT-X003"}
     assert {rule_layer(r) for r in RULES} == set(cli.LAYERS)
 
 
@@ -803,7 +807,7 @@ def test_clean_tree_ast_and_sharding():
 
 def test_clean_tree_full_collect():
     """The acceptance gate: zero non-baselined findings on the whole repo —
-    all seven layers, the same set CI's `graftcheck --baseline` run
+    all nine layers, the same set CI's `graftcheck --baseline` run
     enforces (the collective layer rides the jaxpr layer's sweep traces
     here exactly as it does in the CLI)."""
     fs = cli.collect(cli.repo_root())

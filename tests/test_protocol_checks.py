@@ -1,0 +1,323 @@
+"""R-layer self-tests: one deliberately violating fixture per protocol
+rule (asserting the stable rule id, subject, and line), the PR-19
+regression shapes (rid-after-send, unbounded hello) replayed as sources,
+the import-time parity checks under monkeypatched tables, and the
+clean-tree run (the committed protocol modules carry zero findings)."""
+
+import textwrap
+
+import pytest
+
+from ddim_cold_tpu.analysis import protocol_checks as P
+from ddim_cold_tpu.analysis.findings import RULES, rule_layer
+
+WIRE = frozenset({"ServeError", "RequestFailedError", "TimeoutError",
+                  "ConnectionError", "ValueError", "RuntimeError"})
+
+
+def _lint(source, rel="fix.py"):
+    return P.lint_source(textwrap.dedent(source), rel, wire_names=WIRE)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ R001
+
+
+def test_r001_table_missing_wire_method():
+    fs = _lint("""\
+        CLIENT_METHODS = ("ping",)
+        CLIENT_EVENT_ARMS = ()
+
+        class C:
+            def warm(self):
+                return self._call("ping"), self._call("warm")
+    """)
+    assert _rules_of(fs) == ["GRAFT-R001"]
+    assert len(fs) == 1
+    assert fs[0].subject == "CLIENT_METHODS:warm"
+    assert fs[0].line == 1  # points at the stale table, not the site
+
+
+def test_r001_table_entry_without_site():
+    fs = _lint("""\
+        SERVER_METHODS = ("ping", "drain")
+        SERVER_EVENTS = ()
+
+        class S:
+            def handle(self, method, msg):
+                self.faults.fire("replica.kill", tag="t|")
+                self.faults.fire("replica.hang", tag="t|")
+                if method == "ping":
+                    return {}
+    """)
+    assert len(fs) == 1
+    assert fs[0].rule == "GRAFT-R001"
+    assert fs[0].subject == "SERVER_METHODS:drain"
+
+
+def test_r001_wire_literals_without_any_table():
+    fs = _lint("""\
+        class C:
+            def ping(self):
+                return self._call("ping")
+    """)
+    assert len(fs) == 1
+    assert fs[0].subject == "missing-table:CLIENT_METHODS"
+
+
+def test_r001_import_half_table_parity(monkeypatch):
+    from ddim_cold_tpu.serve import remote
+
+    monkeypatch.setattr(
+        remote, "CLIENT_EVENT_ARMS",
+        tuple(e for e in remote.CLIENT_EVENT_ARMS
+              if e != "protocol_error"))
+    fs = P._table_parity()
+    assert len(fs) == 1
+    assert fs[0].rule == "GRAFT-R001"
+    assert fs[0].subject == "undispatched-event:protocol_error"
+
+
+def test_r001_health_pin_flags_unprovided_key(monkeypatch):
+    # a key no backend provides AND no consumer reads: one finding per
+    # provider pair plus the consumer-freshness finding
+    monkeypatch.setattr(P, "REQUIRED_HEALTH_KEYS",
+                        P.REQUIRED_HEALTH_KEYS + ("bogus_key",))
+    fs = P._check_health_parity(_repo_root())
+    assert _rules_of(fs) == ["GRAFT-R001"]
+    subjects = {f.subject for f in fs}
+    assert "health-key:Engine:bogus_key" in subjects
+    assert "health-key:StubEngine:bogus_key" in subjects
+    assert "health-key:bogus_key" in subjects  # nobody reads it either
+
+
+def _repo_root():
+    from ddim_cold_tpu.analysis.cli import repo_root
+
+    return repo_root()
+
+
+# ------------------------------------------------------------------ R002
+
+
+def test_r002_unregistered_raise_in_protocol_module():
+    fs = _lint("""\
+        class C:
+            def process(self, method):
+                if method is None:
+                    raise BogusError("not on the wire")
+    """)
+    assert len(fs) == 1
+    assert fs[0].rule == "GRAFT-R002"
+    assert fs[0].subject == "C.process:BogusError"
+    assert fs[0].line == 4
+
+
+def test_r002_registered_raises_and_reraises_pass():
+    fs = _lint("""\
+        class C:
+            def process(self, exc):
+                try:
+                    raise ValueError("typed")
+                except ValueError:
+                    raise
+                raise exc
+    """)
+    assert fs == []
+
+
+def test_r002_wire_roundtrip_clean():
+    assert P._check_wire_roundtrip() == []
+
+
+# ------------------------------------------------------------------ R003
+
+
+PR19_RACE = """\
+    CLIENT_METHODS = ("submit",)
+    CLIENT_EVENT_ARMS = ()
+
+    class Replica:
+        def submit(self, params, ticket):
+            rid = self._next_rid()
+            resp = self._call("submit", params)
+            self._tickets[rid] = ticket
+            return ticket
+"""
+
+
+def test_r003_rid_registered_after_send_the_pr19_race():
+    fs = _lint(PR19_RACE)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "GRAFT-R003"
+    assert f.subject == "Replica.submit"
+    assert f.line == 8  # the late registration statement
+
+
+def test_r003_submit_without_any_registration():
+    fs = _lint("""\
+        CLIENT_METHODS = ("submit",)
+        CLIENT_EVENT_ARMS = ()
+
+        class Replica:
+            def submit(self, params):
+                return self._call("submit", params)
+    """)
+    assert len(fs) == 1
+    assert fs[0].rule == "GRAFT-R003"
+    assert fs[0].subject == "Replica.submit"
+
+
+def test_r003_register_before_send_passes():
+    fs = _lint("""\
+        CLIENT_METHODS = ("submit",)
+        CLIENT_EVENT_ARMS = ()
+
+        class Replica:
+            def submit(self, params, ticket):
+                rid = self._next_rid()
+                self._tickets[rid] = ticket
+                resp = self._call("submit", params)
+                return ticket
+    """)
+    assert fs == []
+
+
+# ------------------------------------------------------------------ R004
+
+
+def test_r004_unchecked_length_prefix():
+    fs = _lint("""\
+        import struct
+
+        def recv_frame(sock):
+            (length,) = struct.unpack(">I", recv_exact(sock, 4))
+            return recv_exact(sock, length)
+    """)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "GRAFT-R004"
+    assert f.subject == "recv_frame:unchecked-length"
+    assert f.line == 4  # the first read fed by the unchecked prefix
+
+
+def test_r004_unbounded_hello_the_pr19_shape():
+    fs = _lint("""\
+        def remote_factory(conn):
+            conn.settimeout(None)
+            hello = recv_frame(conn)
+            return hello
+    """)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "GRAFT-R004"
+    assert f.subject == "remote_factory:unbounded-read"
+    assert f.line == 2
+
+
+def test_r004_uncapped_recv_chunk():
+    fs = _lint("""\
+        def drain(sock, n):
+            return sock.recv(n)
+    """)
+    assert len(fs) == 1
+    assert fs[0].subject == "drain:uncapped-recv"
+
+
+def test_r004_unchecked_sendall():
+    fs = _lint("""\
+        def send_frame(sock, payload):
+            sock.sendall(payload)
+    """)
+    assert len(fs) == 1
+    assert fs[0].subject == "send_frame:unchecked-send"
+
+
+def test_r004_disciplined_wire_functions_pass():
+    fs = _lint("""\
+        import struct
+
+        MAX_FRAME_BYTES = 1 << 30
+
+        def recv_frame(sock):
+            (length,) = struct.unpack(">I", recv_exact(sock, 4))
+            if length > MAX_FRAME_BYTES:
+                raise ValueError("frame too large")
+            return recv_exact(sock, length)
+
+        def recv_exact(sock, n):
+            return sock.recv(min(n, 1 << 20))
+
+        def send_frame(sock, payload):
+            if len(payload) > MAX_FRAME_BYTES:
+                raise ValueError("frame too large")
+            sock.sendall(payload)
+
+        def remote_factory(conn, deadline):
+            conn.settimeout(deadline)
+            hello = recv_frame(conn)
+            conn.settimeout(None)
+            return hello
+    """)
+    assert fs == []
+
+
+# ------------------------------------------------------------------ R005
+
+
+def test_r005_send_path_missing_one_chaos_site():
+    fs = _lint("""\
+        class Replica:
+            def _send(self, frame):
+                self.faults.fire("rpc.drop", tag="t|")
+                self._write(frame)
+    """)
+    # exactly one finding: rpc.drop fires but rpc.latency never does
+    assert len(fs) == 1
+    assert fs[0].rule == "GRAFT-R005"
+    assert fs[0].subject == "rpc.latency"
+
+
+def test_r005_handle_without_kill_hang_sites():
+    fs = _lint("""\
+        SERVER_METHODS = ("ping",)
+        SERVER_EVENTS = ()
+
+        class S:
+            def handle(self, method, msg):
+                if method == "ping":
+                    return {}
+    """)
+    r5 = [f for f in fs if f.rule == "GRAFT-R005"]
+    assert {f.subject for f in r5} == {"replica.kill", "replica.hang"}
+
+
+def test_r005_site_registration_clean():
+    assert P._check_site_registration() == []
+
+
+# ------------------------------------------------- layer wiring + clean
+
+
+def test_r_rules_registered_and_layered():
+    for rule in ("GRAFT-R001", "GRAFT-R002", "GRAFT-R003", "GRAFT-R004",
+                 "GRAFT-R005"):
+        assert rule in RULES
+        assert rule_layer(rule) == "protocol"
+
+
+def test_clean_tree_protocol_layer():
+    """The committed wire is fully disciplined: zero R findings, same as
+    CI's `graftcheck --only R` run."""
+    assert P.run_protocol_checks() == []
+
+
+def test_cli_only_r_runs_protocol_layer(capsys):
+    from ddim_cold_tpu.analysis import cli
+
+    assert cli.main(["--only", "R"]) == 0
+    assert "[layers: protocol]" in capsys.readouterr().out
